@@ -1,0 +1,134 @@
+"""Benchmark harness: steady-state LR+FTRL training throughput.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "examples/sec", "vs_baseline": N}
+
+Baseline: the reference publishes no numbers (BASELINE.md), so
+``vs_baseline`` is measured against a CPU proxy — the same sparse
+LR+FTRL step compiled for this host's CPU backend, standing in for the
+reference's CPU-cluster workers.  The north-star comparison (8-worker
+ps-lite on Criteo) needs that cluster; this proxy is documented in
+BASELINE.md terms: value = accelerator examples/sec, vs_baseline =
+accelerator/CPU-host throughput ratio.
+
+Shapes model Criteo-style CTR: 39 features/sample padded to 40,
+batch 65536 (throughput saturates there on v5e), 2^24-row hashed table.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def build(platform_devices, cfg):
+    from xflow_tpu.models import make_model
+    from xflow_tpu.optim import make_optimizer
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.parallel.step import TrainStep, init_state
+
+    mesh = make_mesh(1, devices=platform_devices[:1])
+    model = make_model(cfg)
+    opt = make_optimizer(cfg)
+    step = TrainStep(model, opt, cfg, mesh)
+    state = init_state(model, opt, cfg, mesh)
+    return step, state
+
+
+def make_batches(cfg, num, seed=0):
+    from xflow_tpu.io.batch import Batch
+
+    rng = np.random.default_rng(seed)
+    b, k = cfg.batch_size, cfg.max_nnz
+    batches = []
+    for _ in range(num):
+        # ~39 real features/sample, Criteo-style; zipf-ish key reuse so the
+        # consolidation path sees realistic duplicate densities
+        nnz = 39
+        mask = np.zeros((b, k), np.float32)
+        mask[:, :nnz] = 1.0
+        keys = rng.integers(0, cfg.table_size, (b, k)).astype(np.int32)
+        hot = rng.integers(0, 1000, (b, k)).astype(np.int32)
+        use_hot = rng.random((b, k)) < 0.3
+        keys = np.where(use_hot, hot, keys)
+        batches.append(
+            Batch(
+                keys=keys,
+                slots=np.broadcast_to(
+                    np.arange(k, dtype=np.int32), (b, k)
+                ).copy(),
+                vals=np.ones((b, k), np.float32),
+                mask=mask,
+                labels=rng.integers(0, 2, b).astype(np.float32),
+                weights=np.ones(b, np.float32),
+            )
+        )
+    return batches
+
+
+def run(step, state, batches, iters, warmup=3):
+    import jax
+
+    device_batches = [step.put_batch(b) for b in batches]
+    def sync(st):
+        # device_get forces real completion; block_until_ready has been
+        # observed returning early on tunneled PJRT platforms
+        jax.device_get(st["tables"]["w"]["param"][:1, 0])
+
+    for i in range(warmup):
+        state, m = step.train(state, device_batches[i % len(device_batches)])
+    sync(state)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, m = step.train(state, device_batches[i % len(device_batches)])
+    sync(state)
+    dt = time.perf_counter() - t0
+    return state, iters * batches[0].batch_size / dt
+
+
+def main() -> None:
+    import jax
+
+    from xflow_tpu.config import Config
+
+    cfg = Config(
+        model="lr",
+        optimizer="ftrl",
+        table_size_log2=24,
+        batch_size=65536,
+        max_nnz=40,
+        num_devices=1,
+    )
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    cpu = jax.devices("cpu")
+
+    batches = make_batches(cfg, 8)
+    if accel:
+        step, state = build(accel, cfg)
+        _, accel_eps = run(step, state, batches, iters=20)
+    else:
+        step, state = build(cpu, cfg)
+        _, accel_eps = run(step, state, batches, iters=10)
+
+    # CPU proxy baseline, smaller table/iters to keep runtime bounded
+    cpu_cfg = cfg.replace(table_size_log2=22, batch_size=16384)
+    cpu_step, cpu_state = build(cpu, cpu_cfg)
+    cpu_batches = make_batches(cpu_cfg, 4)
+    _, cpu_eps = run(cpu_step, cpu_state, cpu_batches, iters=8, warmup=2)
+
+    print(
+        json.dumps(
+            {
+                "metric": "lr_ftrl_train_examples_per_sec",
+                "value": round(accel_eps, 1),
+                "unit": "examples/sec",
+                "vs_baseline": round(accel_eps / cpu_eps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

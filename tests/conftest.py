@@ -1,0 +1,46 @@
+"""Test harness: emulate an 8-device pod on CPU.
+
+The reference proves its whole distributed topology as plain processes
+on one host (scripts/local.sh, SURVEY §4 item 2); the JAX equivalent is
+8 virtual CPU devices via XLA_FLAGS, which every sharding test uses.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Some environments (TPU plugins registered from sitecustomize) import
+# jax before this conftest runs, making the env var too late; backend
+# selection is still lazy, so force it through the config as well.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def toy_dataset(tmp_path_factory):
+    """Synthetic libffm dataset with learnable structure, regenerating the
+    shape of the reference's bundled toy data (SURVEY §2 #19: shards
+    ``prefix-%05d``, ~18 fields/sample, fid < 10^4, ``label\\tfgid:fid:val``
+    lines)."""
+    from tests.gen_data import generate_dataset
+
+    root = tmp_path_factory.mktemp("toy")
+    return generate_dataset(
+        str(root),
+        num_train_shards=3,
+        lines_per_shard=200,
+        num_fields=10,
+        vocab_per_field=8,
+        seed=7,
+        scale=3.0,
+    )

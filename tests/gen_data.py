@@ -1,0 +1,69 @@
+"""Deterministic synthetic libffm data with planted signal.
+
+Regenerates the *format* of the reference's bundled toy data
+(data/small_train-0000N, SURVEY §2 #19: ``label<TAB>fgid:fid:val`` with
+space-separated feature tokens) but with a known generative model so
+convergence tests can assert learnability: each (field, token) pair
+carries a latent weight; the label is Bernoulli(sigmoid(sum of
+weights)).  An LR/FM/MVM learner must reach AUC well above 0.5.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ToyDataset:
+    train_prefix: str
+    test_prefix: str
+    num_train_shards: int
+    lines_per_shard: int
+    num_fields: int
+
+
+def generate_dataset(
+    root: str,
+    num_train_shards: int = 3,
+    lines_per_shard: int = 200,
+    num_fields: int = 18,
+    vocab_per_field: int = 50,
+    seed: int = 7,
+    scale: float = 2.0,
+) -> ToyDataset:
+    rng = np.random.default_rng(seed)
+    true_w = rng.normal(0.0, scale, size=(num_fields, vocab_per_field))
+    os.makedirs(root, exist_ok=True)
+    train_prefix = os.path.join(root, "toy_train")
+    test_prefix = os.path.join(root, "toy_test")
+
+    def write_shard(path: str, n_lines: int) -> None:
+        lines = []
+        for _ in range(n_lines):
+            toks = rng.integers(0, vocab_per_field, size=num_fields)
+            logit = float(true_w[np.arange(num_fields), toks].sum()) / np.sqrt(
+                num_fields
+            )
+            y = int(rng.random() < 1.0 / (1.0 + np.exp(-logit)))
+            feats = " ".join(
+                # fid strings unique per field so hashing can't alias fields
+                f"{f}:{f * vocab_per_field + t}:0.3651"
+                for f, t in enumerate(toks)
+            )
+            lines.append(f"{y}\t{feats}\n")
+        with open(path, "w") as fh:
+            fh.writelines(lines)
+
+    for s in range(num_train_shards):
+        write_shard(f"{train_prefix}-{s:05d}", lines_per_shard)
+    write_shard(f"{test_prefix}-00000", lines_per_shard)
+    return ToyDataset(
+        train_prefix=train_prefix,
+        test_prefix=test_prefix,
+        num_train_shards=num_train_shards,
+        lines_per_shard=lines_per_shard,
+        num_fields=num_fields,
+    )

@@ -1,0 +1,110 @@
+"""Checkpoint/resume (capability gap filled — reference has none,
+SURVEY §5): save → restore roundtrip, resume-continues-identically, and
+cross-mesh restore."""
+
+import numpy as np
+import jax
+
+from xflow_tpu.config import Config
+from xflow_tpu.trainer import Trainer
+from xflow_tpu.utils.checkpoint import latest_checkpoint
+
+
+def cfg_for(ds, tmp, ndev=1, **kw):
+    base = dict(
+        train_path=ds.train_prefix,
+        test_path=ds.test_prefix,
+        epochs=2,
+        batch_size=64,
+        table_size_log2=14,
+        max_nnz=24,
+        num_devices=ndev,
+        checkpoint_dir=str(tmp),
+    )
+    base.update(kw)
+    return Config(model="lr", **base)
+
+
+def host_tables(trainer):
+    return jax.tree.map(
+        lambda a: np.asarray(jax.device_get(a)), trainer.state["tables"]
+    )
+
+
+def test_roundtrip(toy_dataset, tmp_path):
+    t = Trainer(cfg_for(toy_dataset, tmp_path))
+    t.train()
+    before = host_tables(t)
+    step_before = int(jax.device_get(t.state["step"]))
+
+    t2 = Trainer(cfg_for(toy_dataset, tmp_path))
+    cursor = t2.restore()
+    assert cursor is not None and cursor["epoch"] == 2
+    after = host_tables(t2)
+    jax.tree.map(np.testing.assert_array_equal, before, after)
+    assert int(jax.device_get(t2.state["step"])) == step_before
+
+
+def test_resume_training_continues(toy_dataset, tmp_path):
+    # train 4 epochs straight through
+    cfg_full = cfg_for(toy_dataset, tmp_path / "a", epochs=4)
+    tfull = Trainer(cfg_full)
+    tfull.train()
+
+    # train 2, checkpoint, new trainer resumes for 2 more
+    cfg_half = cfg_for(toy_dataset, tmp_path / "b", epochs=2)
+    thalf = Trainer(cfg_half)
+    thalf.train()
+    cfg_rest = cfg_for(toy_dataset, tmp_path / "b", epochs=4)
+    trest = Trainer(cfg_rest)
+    trest.restore()
+    assert trest.epoch == 2
+    trest.train()
+
+    np.testing.assert_allclose(
+        host_tables(tfull)["w"]["param"],
+        host_tables(trest)["w"]["param"],
+        rtol=1e-6,
+        atol=1e-8,
+    )
+
+
+def test_restore_onto_different_mesh(toy_dataset, tmp_path):
+    t1 = Trainer(cfg_for(toy_dataset, tmp_path, ndev=1))
+    t1.train()
+    t8 = Trainer(cfg_for(toy_dataset, tmp_path, ndev=8))
+    t8.restore()
+    np.testing.assert_array_equal(
+        host_tables(t1)["w"]["param"], host_tables(t8)["w"]["param"]
+    )
+    assert len(t8.state["tables"]["w"]["param"].sharding.device_set) == 8
+
+
+def test_latest_checkpoint_empty(tmp_path):
+    assert latest_checkpoint(str(tmp_path)) is None
+
+
+def test_mid_epoch_cursor_used_on_resume(toy_dataset, tmp_path, monkeypatch):
+    """A mid-epoch checkpoint's (shard, offset) cursor must flow into the
+    first train_epoch after restore (not restart the epoch from zero)."""
+    t = Trainer(cfg_for(toy_dataset, tmp_path))
+    # simulate a mid-epoch save: one block into shard 1
+    saved = t.save(shard_idx=1, offset=4096)
+    assert saved is not None
+
+    t2 = Trainer(cfg_for(toy_dataset, tmp_path))
+    cursor = t2.restore()
+    assert (cursor["shard"], cursor["offset"]) == (1, 4096)
+
+    calls = []
+    real = t2.train_epoch
+
+    def spy(start_shard=0, start_offset=0):
+        calls.append((start_shard, start_offset))
+        return real(start_shard=0, start_offset=0)  # toy offsets exceed file
+
+    monkeypatch.setattr(t2, "train_epoch", spy)
+    t2.train()
+    assert calls[0] == (1, 4096)
+    # subsequent epochs start clean
+    assert all(c == (0, 0) for c in calls[1:])

@@ -1,0 +1,78 @@
+"""CLI + library API end-to-end on the synthetic toy data (the
+reference's launch surface: main.cc argv + run_ps_local.sh)."""
+
+import numpy as np
+
+from xflow_tpu.api import XFlow
+from xflow_tpu.train import build_parser, config_from_args, main
+
+
+def test_cli_flags_to_config():
+    args = build_parser().parse_args(
+        [
+            "--train", "/tmp/tr", "--test", "/tmp/te",
+            "--model", "1",  # numeric alias per main.cc:27-45
+            "--epochs", "3", "--optimizer", "sgd", "--batch-size", "32",
+            "--table-size-log2", "12", "--alpha", "0.1", "--no-hash",
+        ]
+    )
+    cfg = config_from_args(args)
+    assert cfg.model == "fm"
+    assert cfg.epochs == 3
+    assert cfg.optimizer == "sgd"
+    assert cfg.alpha == 0.1
+    assert cfg.hash_mode is False
+    assert cfg.table_size == 1 << 12
+
+
+def test_cli_end_to_end(toy_dataset, tmp_path, capsys):
+    rc = main(
+        [
+            "--train", toy_dataset.train_prefix,
+            "--test", toy_dataset.test_prefix,
+            "--model", "lr", "--epochs", "2", "--batch-size", "64",
+            "--table-size-log2", "14", "--max-nnz", "24",
+            "--num-devices", "1",
+            "--pred-out", str(tmp_path / "pred.txt"),
+        ]
+    )
+    assert rc == 0
+    lines = (tmp_path / "pred.txt").read_text().strip().splitlines()
+    assert len(lines) == toy_dataset.lines_per_shard
+    label, pctr = lines[0].split("\t")
+    assert label in ("0", "1")
+    assert 0.0 <= float(pctr) <= 1.0
+
+
+def test_cli_requires_train():
+    assert main(["--model", "lr"]) == 2
+
+
+def test_library_api(toy_dataset, tmp_path):
+    xf = XFlow(
+        toy_dataset.train_prefix,
+        toy_dataset.test_prefix,
+        model="lr",
+        epochs=2,
+        batch_size=64,
+        table_size_log2=14,
+        max_nnz=24,
+        num_devices=1,
+        checkpoint_dir=str(tmp_path),
+    )
+    xf.train()
+    result = xf.evaluate()
+    assert np.isfinite(result["logloss"])
+    assert xf.save() is not None
+    xf2 = XFlow(
+        toy_dataset.train_prefix,
+        toy_dataset.test_prefix,
+        model="lr",
+        epochs=2,
+        batch_size=64,
+        table_size_log2=14,
+        max_nnz=24,
+        num_devices=1,
+        checkpoint_dir=str(tmp_path),
+    )
+    assert xf2.restore() is not None

@@ -1,0 +1,69 @@
+"""Sigmoid clamp, rank-sum AUC (reference algorithm), logloss."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from xflow_tpu.utils.metrics import (
+    AucAccumulator,
+    auc_rank_sum,
+    logloss,
+    sigmoid_ref,
+)
+
+
+def test_sigmoid_clamps():
+    # base.h:54-63: x<-30 → 1e-6, x>30 → 1.0
+    x = jnp.asarray([-31.0, -30.0, 0.0, 30.0, 31.0])
+    p = np.asarray(sigmoid_ref(x))
+    assert p[0] == 1e-6
+    assert p[4] == 1.0
+    np.testing.assert_allclose(p[2], 0.5)
+    assert 0.0 < p[1] < 1e-12 or p[1] > 0  # plain sigmoid at -30
+    np.testing.assert_allclose(p[3], 1.0 / (1.0 + np.exp(-30.0)), rtol=1e-6)
+
+
+def test_auc_perfect_and_random():
+    labels = np.array([1, 1, 0, 0])
+    assert auc_rank_sum(labels, np.array([0.9, 0.8, 0.2, 0.1])) == 1.0
+    assert auc_rank_sum(labels, np.array([0.1, 0.2, 0.8, 0.9])) == 0.0
+    # one class only → NaN (reference prints tp_n only, base.h:102-104)
+    assert np.isnan(auc_rank_sum(np.ones(4), np.random.rand(4)))
+
+
+def test_auc_matches_pairwise_oracle():
+    rng = np.random.default_rng(0)
+    labels = (rng.random(200) < 0.3).astype(int)
+    pctr = rng.random(200)
+    got = auc_rank_sum(labels, pctr)
+    pos = pctr[labels == 1]
+    neg = pctr[labels == 0]
+    # reference counts a positive above a negative; sort-desc walk counts
+    # strictly-greater pairs plus ties ordered positive-first by stable sort.
+    wins = (pos[:, None] > neg[None, :]).sum()
+    assert abs(got - wins / (len(pos) * len(neg))) < 1e-6
+
+
+def test_logloss_natural_log():
+    labels = jnp.asarray([1.0, 0.0])
+    pctr = jnp.asarray([0.8, 0.2])
+    want = -(np.log(0.8) + np.log(0.8)) / 2
+    np.testing.assert_allclose(float(logloss(labels, pctr)), want, rtol=1e-6)
+
+
+def test_logloss_weighted_and_clamped():
+    labels = jnp.asarray([1.0, 0.0, 1.0])
+    pctr = jnp.asarray([1.0, 0.5, 0.5])  # exact 1.0 must not produce inf
+    w = jnp.asarray([1.0, 1.0, 0.0])
+    val = float(logloss(labels, pctr, w))
+    assert np.isfinite(val)
+    np.testing.assert_allclose(val, -np.log(0.5) / 2, rtol=1e-3)
+
+
+def test_accumulator_streams():
+    acc = AucAccumulator()
+    acc.add(np.array([1, 0]), np.array([0.9, 0.1]))
+    acc.add(np.array([1, 0, 1]), np.array([0.8, 0.2, 0.7]), np.array([1, 1, 0]))
+    assert acc.count() == 4
+    ll, auc = acc.compute()
+    assert auc == 1.0
+    assert np.isfinite(ll)

@@ -1,0 +1,129 @@
+"""Model forward/gradient math vs independent numpy oracles, including
+the reference's FM forward/backward quirk (fm_worker.cc:82 vs :140-142)
+and MVM's fixed consistent 1+sum form (checked against autodiff)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xflow_tpu.models.fm import FMModel
+from xflow_tpu.models.lr import LRModel
+from xflow_tpu.models.mvm import MVMModel
+
+B, K, D, S = 4, 6, 5, 4
+
+
+def random_batch(seed=0, binary=True):
+    rng = np.random.default_rng(seed)
+    mask = (rng.random((B, K)) < 0.8).astype(np.float32)
+    return {
+        "keys": jnp.asarray(rng.integers(0, 100, (B, K)), jnp.int32),
+        "slots": jnp.asarray(rng.integers(0, S, (B, K)), jnp.int32),
+        "vals": jnp.asarray(
+            np.ones((B, K), np.float32)
+            if binary
+            else rng.normal(1, 0.3, (B, K)).astype(np.float32)
+        ),
+        "mask": jnp.asarray(mask),
+        "labels": jnp.asarray(rng.integers(0, 2, B).astype(np.float32)),
+        "weights": jnp.ones(B, jnp.float32),
+    }
+
+
+def test_lr_logit_oracle():
+    model = LRModel()
+    batch = random_batch(binary=False)
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(B, K, 1)), jnp.float32)
+    got = np.asarray(model.logit({"w": w}, batch))
+    x = np.asarray(batch["vals"]) * np.asarray(batch["mask"])
+    want = (np.asarray(w)[..., 0] * x).sum(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    g = np.asarray(model.grad_logit({"w": w}, batch)["w"])
+    np.testing.assert_allclose(g[..., 0], x, rtol=1e-6)
+
+
+def test_fm_forward_has_no_half_factor():
+    """logit = w·x + [(Σvx)² − Σ(vx)²] — no ½ (fm_worker.cc:82,86)."""
+    model = FMModel(v_dim=D)
+    batch = random_batch()
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=(B, K, 1)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, K, D)), jnp.float32)
+    got = np.asarray(model.logit({"w": w, "v": v}, batch))
+    x = np.asarray(batch["mask"])  # vals are 1
+    vx = np.asarray(v) * x[..., None]
+    inter = (vx.sum(1) ** 2 - (vx**2).sum(1)).sum(-1)
+    want = (np.asarray(w)[..., 0] * x).sum(-1) + inter
+    np.testing.assert_allclose(got, want, rtol=2e-5)
+
+
+def test_fm_gradient_is_half_scaled_reference_form():
+    """grad_v = (Σ v x − v x)·x — the ½-scaled gradient the reference
+    pushes (fm_worker.cc:140-142), which is NOT the autodiff gradient of
+    the no-½ forward (would be twice this)."""
+    model = FMModel(v_dim=D)
+    batch = random_batch()
+    rng = np.random.default_rng(3)
+    w = jnp.asarray(rng.normal(size=(B, K, 1)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, K, D)), jnp.float32)
+    rows = {"w": w, "v": v}
+    g = model.grad_logit(rows, batch)
+    x = np.asarray(batch["mask"])
+    vx = np.asarray(v) * x[..., None]
+    want_v = (vx.sum(1, keepdims=True) - vx) * x[..., None]
+    np.testing.assert_allclose(np.asarray(g["v"]), want_v, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g["w"])[..., 0], x, rtol=1e-6)
+
+    # autodiff of the forward is exactly 2x on the interaction term
+    auto = jax.grad(lambda vv: model.logit({"w": w, "v": vv}, batch).sum())(v)
+    interaction_auto = np.asarray(auto) - 0.0  # w part not in v grad
+    np.testing.assert_allclose(interaction_auto, 2.0 * want_v, rtol=2e-4, atol=1e-5)
+
+
+def test_mvm_consistent_with_autodiff():
+    """MVM uses the fixed 1+Σ form on both sides, so explicit grads must
+    equal autodiff of the forward."""
+    model = MVMModel(v_dim=D, max_fields=S)
+    batch = random_batch(seed=5, binary=False)
+    rng = np.random.default_rng(6)
+    v = jnp.asarray(rng.normal(0, 0.5, size=(B, K, D)), jnp.float32)
+    explicit = np.asarray(model.grad_logit({"v": v}, batch)["v"])
+    auto = np.asarray(
+        jax.grad(lambda vv: model.logit({"v": vv}, batch).sum())(v)
+    )
+    np.testing.assert_allclose(explicit, auto, rtol=1e-4, atol=1e-5)
+
+
+def test_mvm_forward_oracle():
+    model = MVMModel(v_dim=D, max_fields=S)
+    batch = random_batch(seed=8, binary=False)
+    rng = np.random.default_rng(9)
+    v = np.asarray(rng.normal(0, 0.5, size=(B, K, D)), np.float32)
+    got = np.asarray(model.logit({"v": jnp.asarray(v)}, batch))
+    x = np.asarray(batch["vals"]) * np.asarray(batch["mask"])
+    slots = np.asarray(batch["slots"])
+    want = np.zeros(B)
+    for b in range(B):
+        total = 0.0
+        for d in range(D):
+            prod = 1.0
+            for s in range(S):
+                ssum = sum(
+                    v[b, k, d] * x[b, k] for k in range(K) if slots[b, k] == s
+                )
+                prod *= 1.0 + ssum
+            total += prod
+        want[b] = total
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_mvm_ignores_out_of_range_fields():
+    model = MVMModel(v_dim=D, max_fields=2)
+    batch = random_batch(seed=10)
+    batch["slots"] = jnp.full((B, K), 5, jnp.int32)  # all fields out of range
+    v = jnp.asarray(np.random.default_rng(11).normal(size=(B, K, D)), jnp.float32)
+    # every slot empty → logit = sum_d prod_s 1 = D
+    np.testing.assert_allclose(np.asarray(model.logit({"v": v}, batch)), D)
+    np.testing.assert_array_equal(
+        np.asarray(model.grad_logit({"v": v}, batch)["v"]), 0.0
+    )

@@ -1,0 +1,31 @@
+"""xflow_tpu — a TPU-native sparse CTR-prediction training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the xflow
+parameter-server trainer (sparse Logistic Regression, Factorization
+Machine, and Multi-View Machine with server-side FTRL-proximal / SGD
+updates over ps-lite; see reference src/model, src/optimizer).
+
+Design stance (TPU-first, not a port):
+
+* The parameter server disappears.  The hashed feature weight table —
+  and the FTRL state (n, z) next to it — are ``jax.Array``s row-sharded
+  across a ``jax.sharding.Mesh``.  What the reference did with
+  ``KVWorker::Pull`` becomes an in-step gather of touched rows; what it
+  did with ``KVWorker::Push`` + a server-side handler becomes a
+  consolidate-per-unique-key + gather/update/scatter inside the same
+  pjit'd step (reference: ps-lite Push/Pull at lr_worker.cc:170,175 and
+  the FTRL handler at ftrl.h:38-85).
+* Workers' async Hogwild interleaving is intentionally replaced by
+  synchronous SPMD data parallelism; parity is judged on convergence
+  (logloss/AUC), not update ordering.
+* Everything inside the step is static-shape: minibatches are padded
+  COO (keys / slots / vals / mask), per-key gradient consolidation uses
+  a sort + segment-sum trick instead of dynamic ``unique``.
+"""
+
+from xflow_tpu.config import Config
+from xflow_tpu.api import XFlow
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "XFlow", "__version__"]

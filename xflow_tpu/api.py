@@ -1,0 +1,44 @@
+"""Embed-as-a-library API.
+
+The reference ships a (disabled) C ABI wrapper signalling an intended
+embeddable API: ``XFCreate(handle, train, test)`` / ``XFStartTrain``
+(c_api.h:26-41, build commented out at CMakeLists.txt:28).  This class
+is that capability, done properly: construct with paths + config
+overrides, then train / evaluate / predict / save / restore.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from xflow_tpu.config import Config
+from xflow_tpu.trainer import Trainer
+
+
+class XFlow:
+    def __init__(self, train_path: str = "", test_path: str = "", **overrides: Any):
+        self.config = Config(train_path=train_path, test_path=test_path, **overrides)
+        self.trainer = Trainer(self.config)
+
+    def train(self) -> list[dict]:
+        return self.trainer.train()
+
+    def evaluate(self, pred_out: str | None = None) -> dict:
+        return self.trainer.evaluate(pred_out=pred_out)
+
+    def predict_batch(self, batch) -> np.ndarray:
+        """pctr for one padded Batch (see io/batch.py)."""
+        import jax
+
+        arrays = self.trainer.step.put_batch(batch)
+        return np.asarray(
+            jax.device_get(self.trainer.step.predict(self.trainer.state, arrays))
+        )
+
+    def save(self) -> str | None:
+        return self.trainer.save()
+
+    def restore(self) -> dict | None:
+        return self.trainer.restore()

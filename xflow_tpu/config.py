@@ -1,0 +1,132 @@
+"""Run configuration.
+
+The reference keeps every hyperparameter as a compile-time global
+(ftrl.h:15-20 ``alpha/beta/lambda1/lambda2/w_dim/v_dim``, sgd.h:16
+``learning_rate``, lr_worker.h:68 ``block_size``) plus positional argv
+(main.cc:27-45) and DMLC_* env vars (scripts/local.sh:8-19).  Here the
+whole surface is one dataclass, constructible from CLI flags or JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+
+@dataclasses.dataclass
+class Config:
+    # -- model selection (reference: main.cc:27-45, argv[3] '0'/'1'/'2') --
+    model: str = "lr"  # one of {"lr", "fm", "mvm"}
+
+    # -- data (reference: argv[1]/argv[2] shard prefixes, lr_worker.cc:210) --
+    train_path: str = ""
+    test_path: str = ""
+    epochs: int = 60  # reference default: lr_worker.h:63
+    # Text block size in MiB fed to the streaming loader per pass
+    # (reference: lr_worker.h:68 block_size=2 → 2 MiB at lr_worker.cc:184;
+    # predict uses 4 MiB, lr_worker.cc:80).
+    block_mib: int = 2
+    # Hash mode discards the value field — features are implicitly binary
+    # (reference loader load_minibatch_hash_data_fread,
+    # load_data_from_disk.cc:151 hashes the fid token and never stores val).
+    # With hash_mode=False fids are parsed as integers and vals are kept
+    # (reference loaders load_all_data/load_minibatch_data,
+    # load_data_from_disk.cc:11-57).
+    hash_mode: bool = True
+
+    # -- feature space --
+    # log2 of the hashed weight-table row count.  The reference's table is
+    # an unbounded unordered_map on each server (ftrl.h:84,151); on TPU the
+    # table is a dense HBM-resident array, so the hash space is explicit.
+    # North-star target is 2^28 rows pod-sharded (BASELINE.md).
+    table_size_log2: int = 22
+    # Latent factor count for FM/MVM (reference: ftrl.h:16 v_dim=10).
+    v_dim: int = 10
+    # Static padded features-per-sample inside the jit step.  Samples with
+    # more features than this are truncated (reference has no limit —
+    # features-per-sample is whatever the text line holds).
+    max_nnz: int = 64
+    # Static padded field (fgid/slot) count for MVM's per-field sums
+    # (reference sizes slot arrays from the per-sample max fgid,
+    # mvm_worker.cc:225-243).
+    max_fields: int = 32
+
+    # -- batching --
+    # Examples per device step.  The reference's "minibatch" is whatever a
+    # 2 MiB text block parses to; on TPU the batch must be static.
+    batch_size: int = 1024
+
+    # -- optimizer (reference: ftrl.h:15-20, sgd.h:16) --
+    optimizer: str = "ftrl"  # {"ftrl", "sgd"}
+    alpha: float = 5e-2
+    beta: float = 1.0
+    lambda1: float = 5e-5
+    lambda2: float = 10.0
+    sgd_lr: float = 0.001
+    # Lazy server-side init of latent factors is N(0,1)*1e-2 on first touch
+    # (ftrl.h:114-120); we pre-initialize the whole v table with the same
+    # distribution, which is numerically equivalent (untouched rows never
+    # participate; see optim/ftrl.py docstring).
+    v_init_scale: float = 1e-2
+    seed: int = 0
+
+    # -- parallelism --
+    # Devices in the 1-D mesh ('data' axis).  0 = use all available.
+    num_devices: int = 0
+
+    # -- eval / artifacts --
+    # Rank 0 dumps "(label, pctr)" prediction lines (reference:
+    # pred_<rank>_<block>.txt, lr_worker.cc:74-78).
+    pred_out: str = ""
+    # Checkpoint directory ("" = checkpointing off). Capability gap filled:
+    # the reference has no model save/load at all (SURVEY §5).
+    checkpoint_dir: str = ""
+    checkpoint_every_steps: int = 0  # 0 = only at epoch ends
+
+    # -- update path --
+    # "dense": scatter-add gradients into a dense [T, D] buffer and apply
+    #   the optimizer recurrence to the whole table each step.  No sort;
+    #   pure elementwise math on HBM-resident arrays — the TPU-fast path.
+    #   Correct because FTRL/SGD updates with g=0 are no-ops/idempotent
+    #   (tests/test_ftrl.py::test_ftrl_zero_grad_is_idempotent).
+    # "sparse": sort + segment-sum consolidation per unique key, then
+    #   gather/update/scatter only touched rows.  O(batch nnz) work,
+    #   preferable when the table vastly exceeds per-step HBM traffic
+    #   budget or on CPU.
+    # Both paths produce identical results (tests/test_update_modes.py).
+    update_mode: str = "dense"
+
+    # -- precision --
+    # Parameter/optimizer state dtype. float32 default; bf16 is not used
+    # for FTRL state (z accumulates small increments).
+    param_dtype: str = "float32"
+
+    def __post_init__(self) -> None:
+        if self.model not in ("lr", "fm", "mvm"):
+            raise ValueError(f"unknown model {self.model!r}")
+        if self.optimizer not in ("ftrl", "sgd"):
+            raise ValueError(f"unknown optimizer {self.optimizer!r}")
+        if self.update_mode not in ("dense", "sparse"):
+            raise ValueError(f"unknown update_mode {self.update_mode!r}")
+        if not 10 <= self.table_size_log2 <= 30:
+            raise ValueError("table_size_log2 must be in [10, 30]")
+
+    @property
+    def table_size(self) -> int:
+        return 1 << self.table_size_log2
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Config":
+        raw: dict[str, Any] = json.loads(text)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - fields
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return cls(**raw)
+
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)

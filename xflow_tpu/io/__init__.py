@@ -1,0 +1,14 @@
+from xflow_tpu.io.hashing import murmur64, murmur64_batch
+from xflow_tpu.io.libffm import parse_block, BlockReader
+from xflow_tpu.io.loader import ShardLoader, shard_path
+from xflow_tpu.io.batch import Batch
+
+__all__ = [
+    "murmur64",
+    "murmur64_batch",
+    "parse_block",
+    "BlockReader",
+    "ShardLoader",
+    "shard_path",
+    "Batch",
+]

@@ -1,0 +1,90 @@
+"""Padded static-shape minibatch representation.
+
+The reference's minibatch is ``Data{fea_matrix: vector<vector<kv>>,
+label: vector<int>}`` with ``kv = {fgid, fid, val}`` (io.h:18-22,61-65) —
+ragged rows of sparse features.  XLA wants static shapes, so a batch is
+a padded COO block: ``[B, K]`` arrays of table keys, field ids (slots),
+values, and a validity mask, plus per-example labels and weights.  Pad
+feature entries carry ``mask=0`` and key 0; pad examples (tail of the
+last batch of a shard) carry ``weight=0`` so the mean-over-batch
+gradient (reference: lr_worker.cc:116-118 divides by row count) uses
+the true example count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Batch:
+    keys: np.ndarray  # int32 [B, K] — row index into the hashed weight table
+    slots: np.ndarray  # int32 [B, K] — field/group id (reference fgid)
+    vals: np.ndarray  # float32 [B, K] — feature value (all-1 in hash mode)
+    mask: np.ndarray  # float32 [B, K] — 1 for real feature entries
+    labels: np.ndarray  # float32 [B] — binary labels
+    weights: np.ndarray  # float32 [B] — 1 for real examples, 0 for padding
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.keys.shape[0])
+
+    @property
+    def max_nnz(self) -> int:
+        return int(self.keys.shape[1])
+
+    def num_real(self) -> int:
+        return int(self.weights.sum())
+
+
+@dataclasses.dataclass
+class ParsedBlock:
+    """CSR view of one parsed text block (pre-padding)."""
+
+    labels: np.ndarray  # float32 [n]
+    row_ptr: np.ndarray  # int64 [n+1]
+    keys: np.ndarray  # int64 [nnz] — already reduced mod table_size
+    slots: np.ndarray  # int32 [nnz]
+    vals: np.ndarray  # float32 [nnz]
+
+    @property
+    def num_samples(self) -> int:
+        return int(self.labels.shape[0])
+
+
+def pack_batch(
+    block: ParsedBlock,
+    start: int,
+    end: int,
+    batch_size: int,
+    max_nnz: int,
+) -> Batch:
+    """Pack samples [start, end) of a CSR block into one padded Batch.
+
+    Rows with more than ``max_nnz`` features are truncated (the reference
+    has no per-sample feature cap; SURVEY §7 hard part (b)).
+    """
+    n = end - start
+    assert 0 < n <= batch_size
+    keys = np.zeros((batch_size, max_nnz), dtype=np.int32)
+    slots = np.zeros((batch_size, max_nnz), dtype=np.int32)
+    vals = np.zeros((batch_size, max_nnz), dtype=np.float32)
+    mask = np.zeros((batch_size, max_nnz), dtype=np.float32)
+    labels = np.zeros(batch_size, dtype=np.float32)
+    weights = np.zeros(batch_size, dtype=np.float32)
+
+    labels[:n] = block.labels[start:end]
+    weights[:n] = 1.0
+    starts = block.row_ptr[start:end]
+    ends = block.row_ptr[start + 1 : end + 1]
+    counts = np.minimum(ends - starts, max_nnz).astype(np.int64)
+    for i in range(n):
+        c = counts[i]
+        s = starts[i]
+        keys[i, :c] = block.keys[s : s + c]
+        slots[i, :c] = block.slots[s : s + c]
+        vals[i, :c] = block.vals[s : s + c]
+        mask[i, :c] = 1.0
+    return Batch(keys=keys, slots=slots, vals=vals, mask=mask, labels=labels, weights=weights)

@@ -1,0 +1,139 @@
+"""libffm text parsing with block streaming.
+
+Behavioral spec is the reference's only production loader,
+``load_minibatch_hash_data_fread`` (load_data_from_disk.cc:103-210):
+
+* reads a fixed-size byte block per pass and carries the partial last
+  line over to the next pass (:108-124);
+* a line is ``label<SEP>fgid:fid:val ...`` — whitespace-separated
+  feature tokens after the label;
+* the label is binarized ``y > 1e-7 → 1`` (:131-134);
+* ``fgid`` parses as an integer field/group id;
+* in hash mode the ``fid`` token is hashed **as a string** and the
+  value field is discarded — features are implicitly binary (:151);
+* in numeric mode (reference loaders at :11-57) ``fid`` parses as an
+  integer and ``val`` as a float and both are kept.
+
+Differences from the reference, on purpose: the hash is MurmurHash64A,
+not ``std::hash<string>`` (see hashing.py); malformed tokens are skipped
+with a count rather than undefined behavior.
+"""
+
+from __future__ import annotations
+
+import io as _stdio
+from typing import BinaryIO, Iterator
+
+import numpy as np
+
+from xflow_tpu.io.batch import ParsedBlock
+from xflow_tpu.io.hashing import murmur64_batch
+
+LABEL_THRESHOLD = 1e-7  # reference: load_data_from_disk.cc:131-134
+
+
+class BlockReader:
+    """Streams a binary file in ~block_bytes chunks of whole lines,
+    carrying the partial last line between reads (reference
+    load_data_from_disk.cc:108-124)."""
+
+    def __init__(self, f: BinaryIO, block_bytes: int):
+        self._f = f
+        self._block_bytes = max(int(block_bytes), 1)
+        self._carry = b""
+
+    def __iter__(self) -> Iterator[bytes]:
+        while True:
+            chunk = self._f.read(self._block_bytes)
+            if not chunk:
+                if self._carry:
+                    carry, self._carry = self._carry, b""
+                    yield carry
+                return
+            buf = self._carry + chunk
+            cut = buf.rfind(b"\n")
+            if cut == -1:
+                self._carry = buf
+                continue
+            self._carry = buf[cut + 1 :]
+            yield buf[: cut + 1]
+
+
+def parse_block(
+    data: bytes,
+    table_size: int,
+    hash_mode: bool = True,
+    hash_seed: int = 0,
+) -> ParsedBlock:
+    """Parse one block of libffm lines into a CSR ParsedBlock.
+
+    Keys are reduced modulo ``table_size`` (the TPU table is a dense
+    array, unlike the reference's unbounded server-side hash map,
+    ftrl.h:84).
+    """
+    labels: list[float] = []
+    row_ptr: list[int] = [0]
+    slots: list[int] = []
+    vals: list[float] = []
+    tokens: list[bytes] = []  # fid tokens (hash mode)
+    fids: list[int] = []  # numeric fids (no-hash mode)
+
+    for line in data.split(b"\n"):
+        line = line.strip()
+        if not line:
+            continue
+        parts = line.split()
+        try:
+            y = float(parts[0])
+        except ValueError:
+            continue
+        labels.append(1.0 if y > LABEL_THRESHOLD else 0.0)
+        for tok in parts[1:]:
+            pieces = tok.split(b":")
+            if len(pieces) != 3:
+                continue
+            try:
+                fgid = int(pieces[0])
+            except ValueError:
+                continue
+            if hash_mode:
+                tokens.append(pieces[1])
+                vals.append(1.0)  # value field discarded: binary features
+            else:
+                try:
+                    fid = int(pieces[1])
+                    val = float(pieces[2])
+                except ValueError:
+                    continue
+                fids.append(fid)
+                vals.append(val)
+            slots.append(fgid)
+        row_ptr.append(len(slots))
+
+    if hash_mode:
+        hashed = murmur64_batch(tokens, seed=hash_seed)
+        keys = (hashed % np.uint64(table_size)).astype(np.int64)
+    else:
+        keys = np.asarray(fids, dtype=np.int64) % table_size
+
+    return ParsedBlock(
+        labels=np.asarray(labels, dtype=np.float32),
+        row_ptr=np.asarray(row_ptr, dtype=np.int64),
+        keys=keys,
+        slots=np.asarray(slots, dtype=np.int32),
+        vals=np.asarray(vals, dtype=np.float32),
+    )
+
+
+def parse_file(
+    path: str, table_size: int, hash_mode: bool = True, hash_seed: int = 0
+) -> ParsedBlock:
+    """Parse an entire file at once (reference ``load_all_*`` loaders,
+    load_data_from_disk.cc:11-33,59-79)."""
+    with open(path, "rb") as f:
+        return parse_block(f.read(), table_size, hash_mode, hash_seed)
+
+
+def open_block_stream(path: str, block_mib: int) -> BlockReader:
+    f: BinaryIO = open(path, "rb", buffering=_stdio.DEFAULT_BUFFER_SIZE)
+    return BlockReader(f, block_mib << 20)

@@ -1,0 +1,22 @@
+from xflow_tpu.models.base import Model, TableSpec
+from xflow_tpu.models.lr import LRModel
+from xflow_tpu.models.fm import FMModel
+from xflow_tpu.models.mvm import MVMModel
+
+
+def make_model(cfg) -> Model:
+    # Reference model dispatch: main.cc:27-45, argv[3] '0'→LR '1'→FM '2'→MVM.
+    if cfg.model == "lr":
+        return LRModel()
+    if cfg.model == "fm":
+        return FMModel(v_dim=cfg.v_dim, v_init_scale=cfg.v_init_scale)
+    if cfg.model == "mvm":
+        return MVMModel(
+            v_dim=cfg.v_dim,
+            v_init_scale=cfg.v_init_scale,
+            max_fields=cfg.max_fields,
+        )
+    raise ValueError(f"unknown model {cfg.model!r}")
+
+
+__all__ = ["Model", "TableSpec", "LRModel", "FMModel", "MVMModel", "make_model"]

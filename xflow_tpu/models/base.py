@@ -1,0 +1,52 @@
+"""Model protocol: pluggable losses over gathered sparse rows.
+
+A model declares its parameter tables (the reference's "stores": LR
+uses store 0 (w) only, FM stores 0+1 (w, v), MVM store 1 (v) only —
+server.h:23-28, lr_worker.h:38, fm_worker.h:37-38, mvm_worker.h:38) and
+provides, for a batch whose rows are already gathered to [B, K, D]
+blocks:
+
+* ``logit(rows, batch) -> [B]`` — the pre-sigmoid score;
+* ``grad_logit(rows, batch) -> {table: [B, K, D]}`` — d logit / d row
+  entry, per occurrence.
+
+Gradients are explicit, not autodiff, because the reference's FM
+backward is *not* the true gradient of its forward (fm_worker.cc:82 vs
+:140-142 — the ½ factor is dropped in forward only) and parity requires
+reproducing that; see models/fm.py.
+
+The train step turns these into parameter updates:
+``g_occurrence = (sigma(logit) - y) * weight / num_real * grad_logit``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Protocol
+
+import jax
+
+# Batch as a jit-friendly pytree: keys/slots/vals/mask [B,K], labels/weights [B].
+BatchArrays = dict[str, jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class TableSpec:
+    name: str
+    dim: int  # row width (1 for w; v_dim for latent factors)
+    init: Callable[[jax.Array, tuple[int, int]], jax.Array]  # (rng, shape) -> array
+
+
+class Model(Protocol):
+    name: str
+
+    def tables(self) -> list[TableSpec]:
+        ...
+
+    def logit(self, rows: dict[str, jax.Array], batch: BatchArrays) -> jax.Array:
+        ...
+
+    def grad_logit(
+        self, rows: dict[str, jax.Array], batch: BatchArrays
+    ) -> dict[str, jax.Array]:
+        ...

@@ -1,0 +1,74 @@
+"""2-way Factorization Machine (reference: src/model/fm/fm_worker.{h,cc}).
+
+Forward (fm_worker.cc:63-86):
+
+    logit = sum_i w_i x_i + sum_d [ (sum_i v_id x_i)^2 - sum_i v_id^2 x_i^2 ]
+
+Note the standard FM ½ factor on the interaction term is **absent** in
+the reference forward (fm_worker.cc:82,86) — reproduced here.
+
+Backward (fm_worker.cc:140-142): grad_w_i = 1, grad_v_id =
+(sum_j v_jd x_j - v_id x_i) * x_i — i.e. the gradient of the *½-scaled*
+forward.  The forward/backward pair is therefore inconsistent by a
+factor of 2 on the interaction term; this is reference semantics and is
+reproduced exactly (and why grads here are explicit, not autodiff).
+
+v rows are initialized N(0,1)*1e-2 (the reference does this lazily
+server-side on first touch, ftrl.h:113-120; see optim/ftrl.py for the
+equivalence argument), laid out [key, d in 0..v_dim) as in
+fm_worker.cc:71.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.models.base import BatchArrays, TableSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class FMModel:
+    v_dim: int = 10  # reference: ftrl.h:16
+    v_init_scale: float = 1e-2
+    name: str = "fm"
+
+    def tables(self) -> list[TableSpec]:
+        return [
+            TableSpec("w", 1, lambda rng, shape: jnp.zeros(shape, jnp.float32)),
+            TableSpec(
+                "v",
+                self.v_dim,
+                lambda rng, shape: (
+                    jax.random.normal(rng, shape, jnp.float32) * self.v_init_scale
+                ),
+            ),
+        ]
+
+    def _interaction_pieces(
+        self, rows: dict[str, jax.Array], batch: BatchArrays
+    ) -> tuple[jax.Array, jax.Array]:
+        x = (batch["vals"] * batch["mask"])[..., None]  # [B, K, 1]
+        vx = rows["v"] * x  # [B, K, D]
+        sum_vx = jnp.sum(vx, axis=1)  # [B, D]
+        sum_vx2 = jnp.sum(vx * vx, axis=1)  # [B, D]
+        return sum_vx, sum_vx2
+
+    def logit(self, rows: dict[str, jax.Array], batch: BatchArrays) -> jax.Array:
+        x = batch["vals"] * batch["mask"]
+        linear = jnp.sum(rows["w"][..., 0] * x, axis=-1)
+        sum_vx, sum_vx2 = self._interaction_pieces(rows, batch)
+        # No ½ factor: fm_worker.cc:82,86.
+        return linear + jnp.sum(sum_vx * sum_vx - sum_vx2, axis=-1)
+
+    def grad_logit(
+        self, rows: dict[str, jax.Array], batch: BatchArrays
+    ) -> dict[str, jax.Array]:
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        sum_vx, _ = self._interaction_pieces(rows, batch)
+        vx = rows["v"] * x[..., None]
+        # (sum_vx - v_id x_i) * x_i — fm_worker.cc:140-142 (½-scaled form).
+        grad_v = (sum_vx[:, None, :] - vx) * x[..., None]
+        return {"w": x[..., None], "v": grad_v}

@@ -1,0 +1,39 @@
+"""Sparse logistic regression (reference: src/model/lr/lr_worker.{h,cc}).
+
+Forward: wx[b] = sum of the gathered w entries for the sample's features
+(lr_worker.cc:121-143 — the reference's two-pointer join of sorted
+sample keys against the pulled unique-key slice; here a masked gather
+reduction).  The reference's hash-mode features are binary so it sums
+bare w; we multiply by the feature value, which is 1.0 in hash mode
+(parity) and carries real values in numeric mode (superset).
+
+Gradient: d wx / d w_i = x_i (= 1 for binary); the train step scales by
+(sigma(wx) - y) / batch_n, matching calculate_gradient's mean-over-batch
+(lr_worker.cc:100-119).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.models.base import BatchArrays, TableSpec
+
+
+class LRModel:
+    name = "lr"
+
+    def tables(self) -> list[TableSpec]:
+        # w entries are zero-initialized server-side in the reference
+        # (ftrl.h:50-53 default-constructed map entries).
+        return [TableSpec("w", 1, lambda rng, shape: jnp.zeros(shape, jnp.float32))]
+
+    def logit(self, rows: dict[str, jax.Array], batch: BatchArrays) -> jax.Array:
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        return jnp.sum(rows["w"][..., 0] * x, axis=-1)
+
+    def grad_logit(
+        self, rows: dict[str, jax.Array], batch: BatchArrays
+    ) -> dict[str, jax.Array]:
+        x = batch["vals"] * batch["mask"]
+        return {"w": x[..., None]}

@@ -1,0 +1,96 @@
+"""Multi-View Machine (reference: src/model/mvm/mvm_worker.{h,cc}).
+
+Per factor d, the reference sums v within each field (fgid/slot), then
+multiplies across fields, then sums over factors (mvm_worker.cc:67-95):
+
+    reference forward:  logit = sum_d  prod_s ( sum_{i in s} v_id )
+    reference backward: grad_v_id = prod_s(...) / (1 + slotsum_{s(i),d})
+                        (0 when the slot sum is 0, mvm_worker.cc:155-156)
+
+The reference's forward multiplies the bare slot sum but its backward
+divides by (1 + slot sum) — a forward/backward mismatch flagged in the
+SURVEY quirks ledger with the recommendation to fix both sides to the
+``1 + sum`` form (which also matches the MVM paper's view-augmentation
+with a constant-1 feature, and makes empty fields contribute a neutral
+factor 1).  We implement the fixed, consistent form:
+
+    logit = sum_d prod_s (1 + slotsum_sd)
+    grad_v_id = x_i * prod_s(1 + slotsum_sd) / (1 + slotsum_{s(i),d})
+
+This is the one intentional numeric divergence from the reference for
+MVM; documented here and exercised in tests/test_models.py.
+
+Field handling: the reference sizes per-sample slot arrays from the max
+fgid seen (mvm_worker.cc:225-243); under static shapes fields are fixed
+to ``max_fields`` and features with fgid >= max_fields are ignored
+(config.max_fields).  MVM uses only the v table (store 1,
+mvm_worker.h:38); v rows init N(0,1)*1e-2 like FM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.models.base import BatchArrays, TableSpec
+
+_GUARD_EPS = 1e-12
+
+
+@dataclasses.dataclass(frozen=True)
+class MVMModel:
+    v_dim: int = 10
+    v_init_scale: float = 1e-2
+    max_fields: int = 32
+    name: str = "mvm"
+
+    def tables(self) -> list[TableSpec]:
+        return [
+            TableSpec(
+                "v",
+                self.v_dim,
+                lambda rng, shape: (
+                    jax.random.normal(rng, shape, jnp.float32) * self.v_init_scale
+                ),
+            )
+        ]
+
+    def _slot_terms(
+        self, rows: dict[str, jax.Array], batch: BatchArrays
+    ) -> tuple[jax.Array, jax.Array]:
+        """Returns (one_plus_slotsum [B, S, D], prod over S [B, D])."""
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        onehot = jax.nn.one_hot(
+            batch["slots"], self.max_fields, dtype=x.dtype
+        )  # [B, K, S]; fgid >= max_fields rows are all-zero → feature ignored
+        vx = rows["v"] * x[..., None]  # [B, K, D]
+        slotsum = jnp.einsum("bks,bkd->bsd", onehot, vx)  # [B, S, D]
+        one_plus = 1.0 + slotsum
+        prod = jnp.prod(one_plus, axis=1)  # [B, D]
+        return one_plus, prod
+
+    def logit(self, rows: dict[str, jax.Array], batch: BatchArrays) -> jax.Array:
+        _, prod = self._slot_terms(rows, batch)
+        return jnp.sum(prod, axis=-1)
+
+    def grad_logit(
+        self, rows: dict[str, jax.Array], batch: BatchArrays
+    ) -> dict[str, jax.Array]:
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        one_plus, prod = self._slot_terms(rows, batch)
+        slot_idx = jnp.clip(batch["slots"], 0, self.max_fields - 1)  # [B, K]
+        own = jnp.take_along_axis(
+            one_plus,
+            slot_idx[:, :, None],  # [B, K, 1] indexing axis 1 (S); broadcasts over D
+            axis=1,
+        )  # [B, K, D]
+        safe = jnp.where(jnp.abs(own) < _GUARD_EPS, 1.0, own)
+        grad_v = jnp.where(
+            jnp.abs(own) < _GUARD_EPS,
+            0.0,  # guard mirrors the reference zeroing at mvm_worker.cc:156
+            prod[:, None, :] / safe,
+        ) * x[..., None]
+        valid = (batch["slots"] < self.max_fields)[..., None]
+        return {"v": jnp.where(valid, grad_v, 0.0)}

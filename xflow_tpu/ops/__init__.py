@@ -1,0 +1,3 @@
+from xflow_tpu.ops.sparse import consolidate, gather_rows, scatter_rows, PAD_SENTINEL_FOR
+
+__all__ = ["consolidate", "gather_rows", "scatter_rows", "PAD_SENTINEL_FOR"]

@@ -1,0 +1,35 @@
+"""Optimizer protocol.
+
+Reference optimizers are server-side request-handler functors closing
+over per-key state maps (ftrl.h:22-155, sgd.h:18-112).  Here an
+optimizer is a pure function over gathered state rows: it declares what
+auxiliary state accompanies a parameter table and how a row updates
+given the consolidated gradient for its key.  The framework owns
+gather/scatter and sharding; the optimizer sees only dense [U, D]
+blocks, so the same code runs on any mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+import jax
+
+
+class Optimizer(Protocol):
+    name: str
+
+    def init_aux(self, param: jax.Array) -> dict[str, jax.Array]:
+        """Auxiliary state arrays, same shape/sharding as ``param``."""
+        ...
+
+    def update_rows(
+        self, rows: dict[str, jax.Array], g: jax.Array
+    ) -> dict[str, jax.Array]:
+        """Pure per-row update.
+
+        ``rows`` maps "param" plus each aux name to [U, D] blocks;
+        ``g`` is the consolidated gradient [U, D].  Must be well-defined
+        for g=0 (padding) and idempotent there.
+        """
+        ...

@@ -1,0 +1,69 @@
+"""FTRL-proximal, the reference's production optimizer.
+
+Exact recurrence of the server push handler (ftrl.h:58-74), per key k
+with incoming gradient g:
+
+    n' = n + g^2
+    sigma = (sqrt(n') - sqrt(n)) / alpha
+    z' = z + g - sigma * w
+    w' = 0                                   if |z'| <= lambda1
+       = (sign(z')*lambda1 - z') / ((beta + sqrt(n')) / alpha + lambda2)
+                                             otherwise
+
+Pull returns the stored w (ftrl.h:75-76) — in this framework the table
+is HBM-resident so "pull" is just the gather in the train step.
+
+Defaults match ftrl.h:17-20: alpha=5e-2, beta=1.0, lambda1=5e-5,
+lambda2=10.0.
+
+Latent-factor (v) tables: the reference lazily initializes v entries
+with N(0,1)*1e-2 on first touch, server-side inside the optimizer
+(ftrl.h:113-120), with n=z=0.  We pre-initialize the whole v table with
+the same distribution at state creation instead (models/fm.py,
+models/mvm.py).  This is behaviorally equivalent: an untouched row is
+never read, and the first push overwrites v from (z, n') exactly as the
+reference handler does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class FTRL:
+    alpha: float = 5e-2
+    beta: float = 1.0
+    lambda1: float = 5e-5
+    lambda2: float = 10.0
+    name: str = "ftrl"
+
+    def init_aux(self, param: jax.Array) -> dict[str, jax.Array]:
+        return {
+            "n": jnp.zeros_like(param),
+            "z": jnp.zeros_like(param),
+        }
+
+    def update_rows(
+        self, rows: dict[str, jax.Array], g: jax.Array
+    ) -> dict[str, jax.Array]:
+        w, n, z = rows["param"], rows["n"], rows["z"]
+        n_new = n + g * g
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / self.alpha
+        z_new = z + g - sigma * w
+        shrink = (jnp.sign(z_new) * self.lambda1 - z_new) / (
+            (self.beta + jnp.sqrt(n_new)) / self.alpha + self.lambda2
+        )
+        w_new = jnp.where(jnp.abs(z_new) <= self.lambda1, 0.0, shrink)
+        # Never-touched entries (n' = n + g^2 == 0 iff no gradient has ever
+        # arrived) keep their initialization — the lazy server-side init
+        # semantics of ftrl.h:113-120, required so the dense update path
+        # doesn't wipe random v init table-wide on step 1.  A *touched*
+        # entry pushed an exactly-zero gradient (sigmoid clamp) has n > 0
+        # and is recomputed from (z, n), matching the reference handler's
+        # unconditional recompute (ftrl.h:58-74).
+        w_new = jnp.where(n_new == 0.0, w, w_new)
+        return {"param": w_new, "n": n_new, "z": z_new}

@@ -1,0 +1,27 @@
+"""Plain SGD (the reference's dormant alternative, sgd.h:18-112).
+
+Push applies ``w -= lr * g`` with lr=0.001 (sgd.h:16,52).  The
+reference's pull branch contains a duplicated-inner-loop bug
+(sgd.h:53-57, nested ``for j`` inside ``for j``) — fixed here, per the
+SURVEY quirks ledger: pull is an identity read.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class SGD:
+    lr: float = 0.001
+    name: str = "sgd"
+
+    def init_aux(self, param: jax.Array) -> dict[str, jax.Array]:
+        return {}
+
+    def update_rows(
+        self, rows: dict[str, jax.Array], g: jax.Array
+    ) -> dict[str, jax.Array]:
+        return {"param": rows["param"] - self.lr * g}

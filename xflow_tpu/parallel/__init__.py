@@ -1,0 +1,11 @@
+from xflow_tpu.parallel.mesh import make_mesh, table_sharding, batch_sharding
+from xflow_tpu.parallel.step import TrainStep, init_state, batch_to_arrays
+
+__all__ = [
+    "make_mesh",
+    "table_sharding",
+    "batch_sharding",
+    "TrainStep",
+    "init_state",
+    "batch_to_arrays",
+]

@@ -1,0 +1,53 @@
+"""Device mesh and sharding layout — the ps-lite replacement.
+
+The reference scales two ways (SURVEY §2): data parallelism (each
+worker process streams its own shard, lr_worker.cc:210) and parameter
+sharding (ps-lite range-partitions the uint64 key space over servers).
+On TPU both collapse onto one 1-D mesh axis ``"data"``:
+
+* weight/optimizer tables [T, D] are **row-sharded**: rows split into
+  contiguous blocks across devices — the moral equivalent of ps-lite's
+  contiguous key-range server partition;
+* minibatches are sharded on the batch dimension (data parallelism);
+* the cross-device traffic the reference did with ZMQ Push/Pull becomes
+  XLA-inserted collectives on the gather/scatter between the data-
+  sharded batch and the row-sharded table, riding ICI.
+
+Bootstrap: where the reference needed a scheduler + DMLC_* env vars
+(scripts/local.sh:8-19), multi-host here is ``jax.distributed
+.initialize()`` + SPMD; single-host multi-device needs nothing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_devices: int = 0, devices: list | None = None) -> Mesh:
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if num_devices:
+        if num_devices > len(devs):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devs)}"
+            )
+        devs = devs[:num_devices]
+    return Mesh(np.asarray(devs), (DATA_AXIS,))
+
+
+def table_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded, columns replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dimension sharded."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

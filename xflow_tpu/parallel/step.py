@@ -1,0 +1,168 @@
+"""The pjit'd train/predict step — workers, server, and optimizer fused
+into one XLA program.
+
+One reference minibatch costs: per-thread sort+unique
+(lr_worker.cc:147-166), a blocking Pull RPC, the loss/gradient joins
+(lr_worker.cc:100-143), a blocking Push RPC, and the server-side FTRL
+loop (ftrl.h:54-79).  Here the whole round trip is a single jitted
+function over sharded arrays:
+
+    gather rows → logit → clamped sigmoid → residual
+    → per-occurrence grads → consolidate per unique key
+    → gather state rows → optimizer recurrence → scatter back
+
+Gradient scaling matches the reference: the per-key gradient is the sum
+of (sigma(logit)-y) contributions over the minibatch divided by the
+real example count (lr_worker.cc:116-118).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.config import Config
+from xflow_tpu.io.batch import Batch
+from xflow_tpu.models.base import BatchArrays, Model
+from xflow_tpu.ops.sparse import consolidate, gather_rows, scatter_rows
+from xflow_tpu.optim.base import Optimizer
+from xflow_tpu.parallel.mesh import batch_sharding, table_sharding
+from xflow_tpu.utils.metrics import logloss, sigmoid_ref
+
+# State pytree:
+# {"tables": {name: {"param": [T,D], <aux>: [T,D]...}}, "step": int32 scalar}
+State = dict[str, Any]
+
+
+def init_state(model: Model, optimizer: Optimizer, cfg: Config, mesh) -> State:
+    """Create sharded zero/random-initialized tables.
+
+    v-table random init reproduces the reference's lazy server-side
+    N(0,1)*1e-2 (ftrl.h:113-120) eagerly; see optim/ftrl.py.
+    """
+    sharding = table_sharding(mesh)
+    rng = jax.random.PRNGKey(cfg.seed)
+    tables: dict[str, dict[str, jax.Array]] = {}
+    for i, spec in enumerate(model.tables()):
+        shape = (cfg.table_size, spec.dim)
+        init_fn = jax.jit(
+            functools.partial(spec.init, shape=shape), out_shardings=sharding
+        )
+        param = init_fn(jax.random.fold_in(rng, i))
+        entry = {"param": param}
+        for aux_name, aux in optimizer.init_aux(param).items():
+            entry[aux_name] = jax.device_put(aux, sharding)
+        tables[spec.name] = entry
+    return {"tables": tables, "step": jnp.zeros((), jnp.int32)}
+
+
+def batch_to_arrays(batch: Batch) -> BatchArrays:
+    return {
+        "keys": jnp.asarray(batch.keys),
+        "slots": jnp.asarray(batch.slots),
+        "vals": jnp.asarray(batch.vals),
+        "mask": jnp.asarray(batch.mask),
+        "labels": jnp.asarray(batch.labels),
+        "weights": jnp.asarray(batch.weights),
+    }
+
+
+class TrainStep:
+    """Holds the compiled train/predict functions for one (model,
+    optimizer, config, mesh) combination."""
+
+    def __init__(self, model: Model, optimizer: Optimizer, cfg: Config, mesh):
+        self.model = model
+        self.optimizer = optimizer
+        self.cfg = cfg
+        self.mesh = mesh
+        self._bsharding = batch_sharding(mesh)
+        self.train = jax.jit(self._train_impl, donate_argnums=0)
+        self.predict = jax.jit(self._predict_impl)
+
+    # -- helpers -----------------------------------------------------------
+
+    def put_batch(self, batch: Batch) -> BatchArrays:
+        arrays = batch_to_arrays(batch)
+        if jax.process_count() > 1:
+            # Each host loaded its own shard subset (trainer._my_shards);
+            # assemble a global array from per-process local batches.
+            from jax.experimental import multihost_utils
+
+            return {
+                k: multihost_utils.host_local_array_to_global_array(
+                    v, self.mesh, self._bsharding.spec
+                )
+                for k, v in arrays.items()
+            }
+        return {
+            k: jax.device_put(v, self._bsharding) for k, v in arrays.items()
+        }
+
+    def _gather_model_rows(
+        self, tables: dict[str, dict[str, jax.Array]], batch: BatchArrays
+    ) -> dict[str, jax.Array]:
+        # Forward gather uses raw keys; padding entries read row 0 but are
+        # masked out of every reduction by batch["mask"].
+        return {name: t["param"][batch["keys"]] for name, t in tables.items()}
+
+    # -- compiled bodies ---------------------------------------------------
+
+    def _train_impl(
+        self, state: State, batch: BatchArrays
+    ) -> tuple[State, dict[str, jax.Array]]:
+        cfg = self.cfg
+        tables = state["tables"]
+        rows = self._gather_model_rows(tables, batch)
+        logit = self.model.logit(rows, batch)
+        pctr = sigmoid_ref(logit)
+        num_real = jnp.maximum(jnp.sum(batch["weights"]), 1.0)
+        # Residual "loss" exactly as the reference names it
+        # (lr_worker.cc:121-143): sigma(wx) - y, zeroed for pad examples,
+        # pre-divided by batch size for the mean-gradient semantics.
+        residual = (pctr - batch["labels"]) * batch["weights"] / num_real
+        grad_occ = self.model.grad_logit(rows, batch)
+
+        sentinel = jnp.int32(cfg.table_size)
+        keys_eff = jnp.where(
+            batch["mask"] > 0, batch["keys"], sentinel
+        ).reshape(-1)
+
+        new_tables = {}
+        for name, table in tables.items():
+            d = table["param"].shape[-1]
+            flat_g = (grad_occ[name] * residual[:, None, None]).reshape(-1, d)
+            if cfg.update_mode == "dense":
+                # Scatter-add consolidates duplicate keys; the optimizer
+                # recurrence then runs elementwise over the full table —
+                # no sort, no row gather/scatter.  Untouched rows see g=0,
+                # for which FTRL/SGD are idempotent (optim docstrings).
+                gbuf = jnp.zeros_like(table["param"]).at[keys_eff].add(
+                    flat_g, mode="drop"
+                )
+                new_tables[name] = self.optimizer.update_rows(table, gbuf)
+            else:
+                ukeys, gsum = consolidate(keys_eff, flat_g, cfg.table_size)
+                state_rows = {
+                    k: gather_rows(arr, ukeys) for k, arr in table.items()
+                }
+                new_rows = self.optimizer.update_rows(state_rows, gsum)
+                new_tables[name] = {
+                    k: scatter_rows(table[k], ukeys, new_rows[k])
+                    for k in table.keys()
+                }
+
+        metrics = {
+            "logloss": logloss(batch["labels"], pctr, batch["weights"]),
+            "count": jnp.sum(batch["weights"]),
+        }
+        new_state = {"tables": new_tables, "step": state["step"] + 1}
+        return new_state, metrics
+
+    def _predict_impl(self, state: State, batch: BatchArrays) -> jax.Array:
+        """pctr per example (reference calculate_pctr, lr_worker.cc:46-61)."""
+        rows = self._gather_model_rows(state["tables"], batch)
+        return sigmoid_ref(self.model.logit(rows, batch))

@@ -1,0 +1,119 @@
+"""CLI entry point.
+
+Covers the reference's launch surface (SURVEY §7 stage 6): the binary's
+positional ``train_prefix test_prefix model_index epochs`` (main.cc:27-
+45) and the run_ps_local.sh / run_ps_dist.sh topologies become one
+command:
+
+    python -m xflow_tpu.train --model lr --train PREFIX --test PREFIX \
+        --epochs 10 [--optimizer ftrl] [--table-size-log2 22] ...
+
+There is no scheduler and no role dispatch: single host just runs; a
+multi-host pod runs the same command per host (JAX distributed
+initialization, one process per host), each host reading its own shard
+subset — the moral equivalent of DMLC_ROLE/DMLC_PS_ROOT_URI env
+bootstrap (scripts/local.sh:8-19) is ``--coordinator`` below.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from xflow_tpu.config import Config
+from xflow_tpu.trainer import Trainer
+
+_MODEL_BY_INDEX = {"0": "lr", "1": "fm", "2": "mvm"}  # main.cc:27-45
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="xflow_tpu.train", description="TPU-native sparse CTR trainer"
+    )
+    p.add_argument("--config", help="JSON config file (flags override it)")
+    p.add_argument("--train", dest="train_path", help="train shard prefix")
+    p.add_argument("--test", dest="test_path", help="test shard prefix")
+    p.add_argument(
+        "--model",
+        choices=["lr", "fm", "mvm", "0", "1", "2"],
+        help="model family (numeric aliases match the reference argv[3])",
+    )
+    p.add_argument("--epochs", type=int)
+    p.add_argument("--optimizer", choices=["ftrl", "sgd"])
+    p.add_argument("--batch-size", type=int, dest="batch_size")
+    p.add_argument("--table-size-log2", type=int, dest="table_size_log2")
+    p.add_argument("--v-dim", type=int, dest="v_dim")
+    p.add_argument("--max-nnz", type=int, dest="max_nnz")
+    p.add_argument("--max-fields", type=int, dest="max_fields")
+    p.add_argument("--block-mib", type=int, dest="block_mib")
+    p.add_argument("--alpha", type=float)
+    p.add_argument("--beta", type=float)
+    p.add_argument("--lambda1", type=float)
+    p.add_argument("--lambda2", type=float)
+    p.add_argument("--sgd-lr", type=float, dest="sgd_lr")
+    p.add_argument("--seed", type=int)
+    p.add_argument("--num-devices", type=int, dest="num_devices")
+    p.add_argument("--no-hash", action="store_true", help="numeric fids, keep values")
+    p.add_argument("--pred-out", dest="pred_out")
+    p.add_argument("--checkpoint-dir", dest="checkpoint_dir")
+    p.add_argument(
+        "--checkpoint-every-steps", type=int, dest="checkpoint_every_steps"
+    )
+    p.add_argument("--resume", action="store_true", help="resume from latest checkpoint")
+    p.add_argument(
+        "--coordinator",
+        help="host:port of process 0 for multi-host (jax.distributed); "
+        "also requires --process-id and --num-processes",
+    )
+    p.add_argument("--process-id", type=int)
+    p.add_argument("--num-processes", type=int)
+    p.add_argument("--skip-eval", action="store_true")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    base = {}
+    if args.config:
+        with open(args.config) as f:
+            base = json.load(f)
+    field_names = {f.name for f in dataclasses.fields(Config)}
+    for name in field_names:
+        val = getattr(args, name, None)
+        if val is not None:
+            base[name] = val
+    if args.model is not None:
+        base["model"] = _MODEL_BY_INDEX.get(args.model, args.model)
+    if args.no_hash:
+        base["hash_mode"] = False
+    return Config(**base)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.coordinator:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+    cfg = config_from_args(args)
+    if not cfg.train_path:
+        print("error: --train is required", file=sys.stderr)
+        return 2
+    trainer = Trainer(cfg)
+    if args.resume:
+        cursor = trainer.restore()
+        if cursor:
+            print(f"resumed at {cursor}", file=sys.stderr)
+    trainer.train()
+    if cfg.test_path and not args.skip_eval:
+        trainer.evaluate()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

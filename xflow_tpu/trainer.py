@@ -1,0 +1,251 @@
+"""Training/eval driver — the worker loop of the reference
+(LRWorker::train / batch_training / predict, lr_worker.cc:73-217)
+re-expressed as a host loop feeding the pjit'd step.
+
+Shard handling: the reference gives each of M worker processes one file
+shard ``prefix-%05d`` by rank (lr_worker.cc:210).  Here one SPMD process
+(per host) walks every shard assigned to it (``shard index % num_hosts
+== host``); device-level data parallelism happens inside the step via
+the batch's sharding, not via processes.
+
+Evaluation reproduces the rank-0-only predict pass (lr_worker.cc:212-
+215): stream the test shard(s), compute pctr, accumulate (label, pctr),
+report rank-sum AUC + logloss, optionally dump prediction lines (the
+reference's pred_<rank>_<block>.txt, lr_worker.cc:74-78).
+"""
+
+from __future__ import annotations
+
+import glob
+import sys
+import time
+from typing import Callable, Iterator
+
+import numpy as np
+
+import jax
+
+from xflow_tpu.config import Config
+from xflow_tpu.io.batch import Batch
+from xflow_tpu.io.loader import ShardLoader, shard_path
+from xflow_tpu.models import make_model
+from xflow_tpu.optim import make_optimizer
+from xflow_tpu.parallel.mesh import make_mesh
+from xflow_tpu.parallel.step import TrainStep, init_state
+from xflow_tpu.utils.checkpoint import (
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from xflow_tpu.utils.metrics import AucAccumulator
+
+
+def find_shards(prefix: str) -> list[str]:
+    """All existing ``prefix-%05d`` shards, in rank order; if none match,
+    treat ``prefix`` itself as a single file."""
+    shards = sorted(glob.glob(glob.escape(prefix) + "-" + "[0-9]" * 5))
+    if not shards:
+        import os
+
+        if os.path.exists(prefix):
+            return [prefix]
+        raise FileNotFoundError(f"no shards matching {prefix}-NNNNN and no file {prefix}")
+    return shards
+
+
+class Trainer:
+    def __init__(
+        self,
+        cfg: Config,
+        mesh=None,
+        log: Callable[[str], None] | None = None,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(cfg.num_devices)
+        ndev = self.mesh.devices.size
+        if cfg.batch_size % ndev:
+            raise ValueError(
+                f"batch_size {cfg.batch_size} not divisible by {ndev} devices"
+            )
+        if cfg.table_size % ndev:
+            raise ValueError(
+                f"table_size {cfg.table_size} not divisible by {ndev} devices"
+            )
+        self.model = make_model(cfg)
+        self.optimizer = make_optimizer(cfg)
+        self.step = TrainStep(self.model, self.optimizer, cfg, self.mesh)
+        self.state = init_state(self.model, self.optimizer, cfg, self.mesh)
+        self.epoch = 0
+        # (shard_idx, byte_offset) to start the next epoch from; set by
+        # restore(), consumed by the first train_epoch() after it.
+        self._resume_cursor: tuple[int, int] = (0, 0)
+        self._log = log if log is not None else lambda s: print(s, file=sys.stderr)
+        # Multi-host: each process reads its own shard subset.
+        self.host = jax.process_index()
+        self.num_hosts = jax.process_count()
+
+    # -- data --------------------------------------------------------------
+
+    def _loader(self, path: str) -> ShardLoader:
+        cfg = self.cfg
+        return ShardLoader(
+            path,
+            batch_size=cfg.batch_size,
+            max_nnz=cfg.max_nnz,
+            table_size=cfg.table_size,
+            block_mib=cfg.block_mib,
+            hash_mode=cfg.hash_mode,
+            hash_seed=cfg.seed,
+        )
+
+    def _my_shards(self, prefix: str) -> list[str]:
+        shards = find_shards(prefix)
+        return [s for i, s in enumerate(shards) if i % self.num_hosts == self.host]
+
+    def iter_train_batches(
+        self, start_shard: int = 0, start_offset: int = 0
+    ) -> Iterator[tuple[Batch, int, int]]:
+        """Yields (batch, shard_index, resume_offset) over one epoch."""
+        shards = self._my_shards(self.cfg.train_path)
+        for si, path in enumerate(shards):
+            if si < start_shard:
+                continue
+            offset = start_offset if si == start_shard else 0
+            for batch, resume in self._loader(path).iter_batches(offset):
+                yield batch, si, resume
+
+    # -- training ----------------------------------------------------------
+
+    def train_epoch(self, start_shard: int = 0, start_offset: int = 0) -> dict:
+        cfg = self.cfg
+        t0 = time.time()
+        steps = 0
+        device_metrics = []  # fetched once at epoch end to keep dispatch async
+        for batch, shard_idx, resume in self.iter_train_batches(
+            start_shard, start_offset
+        ):
+            arrays = self.step.put_batch(batch)
+            self.state, metrics = self.step.train(self.state, arrays)
+            steps += 1
+            device_metrics.append(metrics)
+            if cfg.checkpoint_dir and cfg.checkpoint_every_steps and (
+                steps % cfg.checkpoint_every_steps == 0
+            ):
+                self.save(shard_idx, resume)
+        host_metrics = jax.device_get(device_metrics)
+        seen = float(sum(m["count"] for m in host_metrics))
+        ll_sum = float(
+            sum(m["logloss"] * m["count"] for m in host_metrics)
+        )
+        dt = time.time() - t0
+        return {
+            "epoch": self.epoch,
+            "examples": seen,
+            "steps": steps,
+            "train_logloss": ll_sum / max(seen, 1.0),
+            "examples_per_sec": seen / max(dt, 1e-9),
+            "seconds": dt,
+        }
+
+    def train(self) -> list[dict]:
+        """Full training run (reference batch_training loop over epochs,
+        lr_worker.cc:179-205, with epoch banner every 30 at :202)."""
+        history = []
+        while self.epoch < self.cfg.epochs:
+            start_shard, start_offset = self._resume_cursor
+            self._resume_cursor = (0, 0)
+            stats = self.train_epoch(start_shard, start_offset)
+            history.append(stats)
+            if self.epoch % 30 == 0 or self.epoch == self.cfg.epochs - 1:
+                self._log(
+                    f"epoch {self.epoch}: logloss={stats['train_logloss']:.6f} "
+                    f"examples/s={stats['examples_per_sec']:.0f}"
+                )
+            self.epoch += 1
+            if self.cfg.checkpoint_dir:
+                self.save(0, 0)
+        return history
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, pred_out: str | None = None) -> dict:
+        cfg = self.cfg
+        acc = AucAccumulator()
+        pred_file = None
+        out_path = pred_out if pred_out is not None else cfg.pred_out
+        if out_path and self.host == 0:
+            pred_file = open(out_path, "w")
+        try:
+            for path in self._my_shards(cfg.test_path):
+                # Reference predict uses doubled block size (lr_worker.cc:80).
+                loader = self._loader(path)
+                loader.block_bytes = (cfg.block_mib * 2) << 20
+                for batch, _ in loader.iter_batches():
+                    arrays = self.step.put_batch(batch)
+                    pctr = np.asarray(jax.device_get(self.step.predict(self.state, arrays)))
+                    acc.add(batch.labels, pctr, batch.weights)
+                    if pred_file is not None:
+                        for y, p, w in zip(batch.labels, pctr, batch.weights):
+                            if w > 0:
+                                # "(label, pctr)" lines, lr_worker.cc:62-68.
+                                pred_file.write(f"{int(y)}\t{p:.6f}\n")
+        finally:
+            if pred_file is not None:
+                pred_file.close()
+        if self.num_hosts > 1:
+            # Rank-sum AUC is not decomposable over shard subsets: gather
+            # every host's (label, pctr) pairs before computing (the
+            # reference's rank-0 eval sees the whole test shard too,
+            # lr_worker.cc:212-215).  process_allgather needs equal shapes,
+            # so exchange counts first and pad to the max.
+            from jax.experimental import multihost_utils
+
+            labels, pctr = acc.pairs()
+            n_local = len(labels)
+            counts = np.asarray(
+                multihost_utils.process_allgather(np.int64(n_local))
+            ).reshape(-1)
+            pad_to = int(counts.max())
+            padded = {
+                "labels": np.pad(labels, (0, pad_to - n_local)),
+                "pctr": np.pad(pctr, (0, pad_to - n_local)),
+            }
+            gathered = multihost_utils.process_allgather(padded)
+            acc = AucAccumulator()
+            for h in range(len(counts)):
+                acc.add(
+                    np.asarray(gathered["labels"])[h, : counts[h]],
+                    np.asarray(gathered["pctr"])[h, : counts[h]],
+                )
+        ll, auc = acc.compute()
+        n = acc.count()
+        pos = int(acc.pairs()[0].sum()) if n else 0
+        result = {"logloss": ll, "auc": auc, "examples": n, "tp": pos, "fp": n - pos}
+        self._log(f"logloss: {ll:.6f}\tauc = {auc:.6f}\ttp = {pos} fp = {n - pos}")
+        return result
+
+    # -- checkpointing -----------------------------------------------------
+
+    def save(self, shard_idx: int = 0, offset: int = 0) -> str | None:
+        if not self.cfg.checkpoint_dir:
+            return None
+        cursor = {"epoch": self.epoch, "shard": shard_idx, "offset": offset}
+        return save_checkpoint(
+            self.cfg.checkpoint_dir, self.state, cursor, self.cfg.to_json()
+        )
+
+    def restore(self) -> dict | None:
+        """Resume from the latest checkpoint if one exists; returns the
+        cursor or None."""
+        if not self.cfg.checkpoint_dir:
+            return None
+        path = latest_checkpoint(self.cfg.checkpoint_dir)
+        if path is None:
+            return None
+        self.state, cursor = load_checkpoint(path, self.state)
+        self.epoch = int(cursor.get("epoch", 0))
+        self._resume_cursor = (
+            int(cursor.get("shard", 0)),
+            int(cursor.get("offset", 0)),
+        )
+        return cursor

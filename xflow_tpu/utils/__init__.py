@@ -1,0 +1,8 @@
+from xflow_tpu.utils.metrics import (
+    sigmoid_ref,
+    logloss,
+    auc_rank_sum,
+    AucAccumulator,
+)
+
+__all__ = ["sigmoid_ref", "logloss", "auc_rank_sum", "AucAccumulator"]

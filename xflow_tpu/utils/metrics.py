@@ -1,0 +1,94 @@
+"""Evaluation metrics: clamped sigmoid, logloss, rank-sum AUC.
+
+* ``sigmoid_ref`` reproduces the reference clamp exactly (base.h:54-63):
+  x < -30 → 1e-6, x > 30 → 1.0, else 1/(1+exp(-x)).
+* ``auc_rank_sum`` reproduces the reference algorithm exactly
+  (base.h:84-110): sort by pctr descending; walking down, count
+  positives seen (tp_n) and add tp_n for every negative — i.e. for each
+  negative, the number of positives scored strictly-or-tied above it —
+  then divide by P*N.  No tie averaging, matching the reference.
+* ``logloss`` deliberately diverges per the SURVEY quirks ledger: the
+  reference computes log2-based, un-negated logloss with a stray ``+ +``
+  (base.h:97-98); here it is the standard natural-log negative
+  log-likelihood, with probabilities clamped to [eps, 1-eps] so the
+  sigmoid's exact-1.0 clamp branch doesn't produce inf.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LOGLOSS_EPS = 1e-6
+
+
+def sigmoid_ref(x: jax.Array) -> jax.Array:
+    p = 1.0 / (1.0 + jnp.exp(-x))
+    p = jnp.where(x < -30.0, 1e-6, p)
+    p = jnp.where(x > 30.0, 1.0, p)
+    return p
+
+
+def logloss(labels: jax.Array, pctr: jax.Array, weights: jax.Array | None = None):
+    """Weighted mean negative log-likelihood (natural log)."""
+    p = jnp.clip(pctr, LOGLOSS_EPS, 1.0 - LOGLOSS_EPS)
+    ll = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))
+    if weights is None:
+        return jnp.mean(ll)
+    denom = jnp.maximum(jnp.sum(weights), 1.0)
+    return jnp.sum(ll * weights) / denom
+
+
+def auc_rank_sum(labels: np.ndarray, pctr: np.ndarray) -> float:
+    """Reference rank-sum AUC (base.h:84-110).  Returns NaN when all
+    labels are one class (the reference prints only tp_n then)."""
+    labels = np.asarray(labels)
+    pctr = np.asarray(pctr)
+    order = np.argsort(-pctr, kind="stable")  # pctr descending
+    sorted_labels = labels[order]
+    pos = sorted_labels > 0.5
+    tp_cum = np.cumsum(pos)
+    p = int(tp_cum[-1]) if len(tp_cum) else 0
+    n = len(labels) - p
+    if p == 0 or n == 0:
+        return float("nan")
+    area = float(tp_cum[~pos].sum())
+    return area / (p * n)
+
+
+class AucAccumulator:
+    """Streaming accumulator for (label, pctr) pairs across eval batches
+    (the reference accumulates test_auc_vec under a mutex,
+    lr_worker.cc:62-68, then computes once)."""
+
+    def __init__(self) -> None:
+        self._labels: list[np.ndarray] = []
+        self._pctr: list[np.ndarray] = []
+
+    def add(self, labels: np.ndarray, pctr: np.ndarray, weights: np.ndarray | None = None):
+        labels = np.asarray(labels)
+        pctr = np.asarray(pctr)
+        if weights is not None:
+            keep = np.asarray(weights) > 0
+            labels, pctr = labels[keep], pctr[keep]
+        self._labels.append(labels)
+        self._pctr.append(pctr)
+
+    def count(self) -> int:
+        return int(sum(len(a) for a in self._labels))
+
+    def compute(self) -> tuple[float, float]:
+        """Returns (logloss_ln, auc)."""
+        labels = np.concatenate(self._labels) if self._labels else np.zeros(0)
+        pctr = np.concatenate(self._pctr) if self._pctr else np.zeros(0)
+        if len(labels) == 0:
+            return float("nan"), float("nan")
+        p = np.clip(pctr, LOGLOSS_EPS, 1.0 - LOGLOSS_EPS)
+        ll = -np.mean(labels * np.log(p) + (1.0 - labels) * np.log(1.0 - p))
+        return float(ll), auc_rank_sum(labels, pctr)
+
+    def pairs(self) -> tuple[np.ndarray, np.ndarray]:
+        labels = np.concatenate(self._labels) if self._labels else np.zeros(0)
+        pctr = np.concatenate(self._pctr) if self._pctr else np.zeros(0)
+        return labels, pctr
