@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Multi-host pod launch — the counterpart of the reference's
+# run_ps_dist.sh / scripts/start_{scheduler,server,worker}.sh manual
+# role bootstrap.  Run this same script on EVERY host of the pod; the
+# scheduler's job (rendezvous) is done by JAX's coordinator.
+#
+# Required env:
+#   XF_COORDINATOR   host:port of process 0 (any reachable port there)
+#   XF_NUM_PROCESSES total number of hosts
+#   XF_PROCESS_ID    this host's index, 0-based
+#
+# Each host reads the shard subset {i : i % NUM_PROCESSES == PROCESS_ID}
+# of TRAIN_PREFIX-%05d — the same shard-per-worker layout as the
+# reference (lr_worker.cc:210).
+#
+# Usage: scripts/run_dist.sh TRAIN_PREFIX TEST_PREFIX [MODEL] [EPOCHS]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TRAIN=${1:?train shard prefix required}
+TEST=${2:?test shard prefix required}
+MODEL=${3:-lr}
+EPOCHS=${4:-60}
+
+exec python -m xflow_tpu.train \
+  --model "$MODEL" \
+  --train "$TRAIN" \
+  --test "$TEST" \
+  --epochs "$EPOCHS" \
+  --coordinator "${XF_COORDINATOR:?}" \
+  --num-processes "${XF_NUM_PROCESSES:?}" \
+  --process-id "${XF_PROCESS_ID:?}" \
+  "${@:5}"

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Single-host launch — the counterpart of the reference's
+# run_ps_local.sh + scripts/local.sh (which forked a scheduler, S
+# servers, and W workers with DMLC_* env).  On TPU there are no roles:
+# one process drives every local device via SPMD.
+#
+# Usage: scripts/run_local.sh TRAIN_PREFIX TEST_PREFIX [MODEL] [EPOCHS]
+#   MODEL: lr|fm|mvm or 0|1|2 (reference argv aliases)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TRAIN=${1:?train shard prefix required}
+TEST=${2:?test shard prefix required}
+MODEL=${3:-lr}
+EPOCHS=${4:-60}
+
+exec python -m xflow_tpu.train \
+  --model "$MODEL" \
+  --train "$TRAIN" \
+  --test "$TEST" \
+  --epochs "$EPOCHS" \
+  "${@:5}"

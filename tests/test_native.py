@@ -1,0 +1,195 @@
+"""Native C++ parser: bit/semantics parity with the Python parser over
+structured, malformed, and fuzzed inputs (the native module replaces
+the reference's C++ loader, load_data_from_disk.cc:103-210)."""
+
+import numpy as np
+import pytest
+
+from xflow_tpu.io.hashing import murmur64
+from xflow_tpu.io.libffm import parse_block
+from xflow_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain to build native parser"
+)
+
+TABLE = 1 << 16
+
+
+def assert_blocks_equal(a, b):
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.row_ptr, b.row_ptr)
+    np.testing.assert_array_equal(a.keys, b.keys)
+    np.testing.assert_array_equal(a.slots, b.slots)
+    np.testing.assert_array_equal(a.vals, b.vals)
+
+
+@pytest.mark.parametrize("hash_mode", [True, False])
+def test_parity_structured(hash_mode):
+    data = (
+        b"1\t0:123:0.5 2:456:1.0\n"
+        b"0\t1:123:0.25\n"
+        b"0.5 3:9:2.5 4:-7:1e-3\n"
+        b"1e-8\t0:1:1\n"
+        b"-3\t0:2:1\n"
+        b"\n"
+        b"2 5:77:0.125"  # no trailing newline
+    )
+    py = parse_block(data, TABLE, hash_mode)
+    nat = native.native_parse_block(data, TABLE, hash_mode)
+    assert_blocks_equal(py, nat)
+
+
+def test_parity_malformed():
+    data = (
+        b"1\t0:1:1 garbage x:y:z:extra 2:3 :: a:b:c 1:tok:val trailing\n"
+        b"notalabel\t0:1:1\n"
+        b"nan\t0:1:1\n"
+        b"inf\t0:1:1\n"
+        b"0\t1:5:1\n"
+        b"   \n"
+        b"1\n"
+    )
+    for hash_mode in (True, False):
+        py = parse_block(data, TABLE, hash_mode)
+        nat = native.native_parse_block(data, TABLE, hash_mode)
+        assert_blocks_equal(py, nat)
+
+
+def test_parity_reference_format():
+    # reference toy-data shape: label<TAB>fgid:fid:val with float vals
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(300):
+        feats = " ".join(
+            f"{f}:{rng.integers(0, 10000)}:{rng.random():.4f}"
+            for f in range(18)
+        )
+        lines.append(f"{rng.integers(0, 2)}\t{feats}\n")
+    data = "".join(lines).encode()
+    for hash_mode in (True, False):
+        assert_blocks_equal(
+            parse_block(data, TABLE, hash_mode),
+            native.native_parse_block(data, TABLE, hash_mode),
+        )
+
+
+def test_parity_fuzz():
+    # random token soup (underscore excluded: Python's int()/float() accept
+    # "1_0" digit grouping, a documented non-goal for the native parser)
+    rng = np.random.default_rng(1)
+    alphabet = b"0123456789:.eE+- \tabcxyz\n"
+    for trial in range(20):
+        raw = bytes(
+            alphabet[i] for i in rng.integers(0, len(alphabet), size=2000)
+        )
+        for hash_mode in (True, False):
+            py = parse_block(raw, TABLE, hash_mode)
+            nat = native.native_parse_block(raw, TABLE, hash_mode)
+            assert_blocks_equal(py, nat)
+
+
+def test_parity_extreme_tokens():
+    """Edges found in review: 64+-byte numeric tokens, int64/int32
+    overflow ids, double-rounding-sensitive float values."""
+    long_label = b"0." + b"0" * 70 + b"1"  # > 64 chars, valid float
+    data = (
+        long_label + b"\t0:1:1\n"
+        b"1\t0:99999999999999999999:1\n"  # fid > int64: token skipped
+        b"1\t99999999999:5:1\n"  # fgid > int32: token skipped
+        b"1\t-2147483648:5:1 2147483647:6:1\n"  # int32 bounds kept
+        b"1\t0:7:7.038531e-26 0:8:1.1754944e-38\n"  # double-rounding probes
+        b"1\t0:9:" + b"1" * 80 + b".5\n"  # long val token
+    )
+    for hash_mode in (True, False):
+        py = parse_block(data, TABLE, hash_mode)
+        nat = native.native_parse_block(data, TABLE, hash_mode)
+        assert_blocks_equal(py, nat)
+    # the overflow lines must keep their labels but drop the bad tokens
+    py = parse_block(data, TABLE, hash_mode=False)
+    assert py.num_samples == 6
+    assert py.row_ptr[2] - py.row_ptr[1] == 0  # fid overflow dropped
+    assert py.row_ptr[3] - py.row_ptr[2] == 0  # fgid overflow dropped
+    assert py.row_ptr[4] - py.row_ptr[3] == 2  # int32 bounds kept
+
+
+def test_native_murmur_matches_python():
+    rng = np.random.default_rng(2)
+    for n in list(range(0, 33)) + [100, 1000]:
+        tok = bytes(rng.integers(0, 256, size=n).astype(np.uint8))
+        assert native.native_murmur64(tok) == murmur64(tok)
+        assert native.native_murmur64(tok, 42) == murmur64(tok, 42)
+
+
+def test_hash_seed_parity():
+    data = b"1\t0:sometoken:1\n"
+    py = parse_block(data, TABLE, True, hash_seed=99)
+    nat = native.native_parse_block(data, TABLE, True, hash_seed=99)
+    assert_blocks_equal(py, nat)
+
+
+def test_make_parse_fn_prefers_native(toy_dataset):
+    from xflow_tpu.io.loader import ShardLoader, make_parse_fn
+
+    fn = make_parse_fn(TABLE, True, 0, prefer_native=True)
+    loader = ShardLoader(
+        toy_dataset.train_prefix + "-00000",
+        batch_size=32,
+        max_nnz=16,
+        table_size=TABLE,
+        parse_fn=fn,
+    )
+    total = sum(b.num_real() for b, _ in loader.iter_batches())
+    assert total == toy_dataset.lines_per_shard
+
+
+def test_prefetch_matches_sync(toy_dataset):
+    from xflow_tpu.io.loader import ShardLoader
+
+    loader = ShardLoader(
+        toy_dataset.train_prefix + "-00000",
+        batch_size=32,
+        max_nnz=16,
+        table_size=TABLE,
+    )
+    sync = [(b.keys.copy(), r) for b, r in loader.iter_batches()]
+    pre = [(b.keys.copy(), r) for b, r in loader.prefetch(3)]
+    assert len(sync) == len(pre)
+    for (ka, ra), (kb, rb) in zip(sync, pre):
+        np.testing.assert_array_equal(ka, kb)
+        assert ra == rb
+
+
+def test_parallel_parse_matches_sequential(toy_dataset):
+    from xflow_tpu.io.loader import ShardLoader
+
+    loader = ShardLoader(
+        toy_dataset.train_prefix + "-00000",
+        batch_size=32,
+        max_nnz=16,
+        table_size=TABLE,
+        block_mib=1,
+    )
+    seq = [(b.keys.copy(), b.labels.copy(), r) for b, r in loader.iter_batches()]
+    par = [
+        (b.keys.copy(), b.labels.copy(), r)
+        for b, r in loader.iter_batches(parse_workers=4)
+    ]
+    assert len(seq) == len(par)
+    for (ka, la, ra), (kb, lb, rb) in zip(seq, par):
+        np.testing.assert_array_equal(ka, kb)
+        np.testing.assert_array_equal(la, lb)
+        assert ra == rb
+
+
+def test_prefetch_propagates_errors():
+    from xflow_tpu.io.loader import _prefetch_iter
+
+    def boom():
+        yield 1
+        raise ValueError("boom")
+
+    it = _prefetch_iter(boom(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="boom"):
+        next(it)

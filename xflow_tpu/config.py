@@ -84,6 +84,22 @@ class Config:
     checkpoint_dir: str = ""
     checkpoint_every_steps: int = 0  # 0 = only at epoch ends
 
+    # -- host data path --
+    # Use the native C++ parser (xflow_tpu/native) when a toolchain is
+    # available; falls back to the pure-Python parser silently.
+    native_parser: bool = True
+    # Parse/pack batches on a background thread, this many batches ahead
+    # (0 = synchronous).  Replaces the reference's worker-side ThreadPool
+    # (thread_pool.h) as the host-side parallelism mechanism: here the
+    # device does the math, so host threads overlap parsing with device
+    # compute instead of splitting the minibatch.
+    prefetch_batches: int = 2
+    # Concurrent block parse+pack threads (order-preserving); effective
+    # with the native parser, which releases the GIL.  -1 = auto
+    # (cores-1, capped at 6; sequential on single-core hosts);
+    # 0/1 = sequential.
+    parse_workers: int = -1
+
     # -- update path --
     # "dense": scatter-add gradients into a dense [T, D] buffer and apply
     #   the optimizer recurrence to the whole table each step.  No sort;

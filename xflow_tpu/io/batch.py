@@ -68,23 +68,36 @@ def pack_batch(
     """
     n = end - start
     assert 0 < n <= batch_size
-    keys = np.zeros((batch_size, max_nnz), dtype=np.int32)
-    slots = np.zeros((batch_size, max_nnz), dtype=np.int32)
-    vals = np.zeros((batch_size, max_nnz), dtype=np.float32)
-    mask = np.zeros((batch_size, max_nnz), dtype=np.float32)
     labels = np.zeros(batch_size, dtype=np.float32)
     weights = np.zeros(batch_size, dtype=np.float32)
-
     labels[:n] = block.labels[start:end]
     weights[:n] = 1.0
+
     starts = block.row_ptr[start:end]
     ends = block.row_ptr[start + 1 : end + 1]
-    counts = np.minimum(ends - starts, max_nnz).astype(np.int64)
-    for i in range(n):
-        c = counts[i]
-        s = starts[i]
-        keys[i, :c] = block.keys[s : s + c]
-        slots[i, :c] = block.slots[s : s + c]
-        vals[i, :c] = block.vals[s : s + c]
-        mask[i, :c] = 1.0
-    return Batch(keys=keys, slots=slots, vals=vals, mask=mask, labels=labels, weights=weights)
+    counts = np.minimum(ends - starts, max_nnz)
+    # vectorized ragged→padded gather: position j of row i reads CSR slot
+    # starts[i]+j while j < counts[i]
+    j = np.arange(max_nnz, dtype=np.int64)[None, :]
+    valid = j < counts[:, None]  # [n, K]
+    src = np.where(valid, starts[:, None] + j, 0)
+
+    def pad_gather(flat: np.ndarray, dtype) -> np.ndarray:
+        out = np.zeros((batch_size, max_nnz), dtype=dtype)
+        if len(flat):
+            out[:n] = np.where(valid, flat[src], 0)
+        return out
+
+    return Batch(
+        keys=pad_gather(block.keys, np.int32),
+        slots=pad_gather(block.slots, np.int32),
+        vals=pad_gather(block.vals, np.float32),
+        mask=np.concatenate(
+            [
+                valid.astype(np.float32),
+                np.zeros((batch_size - n, max_nnz), np.float32),
+            ]
+        ),
+        labels=labels,
+        weights=weights,
+    )

@@ -96,6 +96,8 @@ def parse_block(
                 fgid = int(pieces[0])
             except ValueError:
                 continue
+            if not -(2**31) <= fgid < 2**31:
+                continue  # slot arrays are int32; reject, never wrap
             if hash_mode:
                 tokens.append(pieces[1])
                 vals.append(1.0)  # value field discarded: binary features
@@ -105,6 +107,8 @@ def parse_block(
                     val = float(pieces[2])
                 except ValueError:
                     continue
+                if not -(2**63) <= fid < 2**63:
+                    continue  # keys are int64; reject, never wrap
                 fids.append(fid)
                 vals.append(val)
             slots.append(fgid)

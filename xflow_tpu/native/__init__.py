@@ -1,0 +1,8 @@
+from xflow_tpu.native.ffi import (
+    available,
+    load_library,
+    native_murmur64,
+    native_parse_block,
+)
+
+__all__ = ["available", "load_library", "native_murmur64", "native_parse_block"]

@@ -1,0 +1,40 @@
+"""On-demand build of the native parser shared library.
+
+Replaces the reference's CMake build of its io static lib
+(src/io/CMakeLists.txt): one translation unit, built with the system
+g++ the first time it's needed, cached beside the sources, rebuilt when
+the source is newer than the cached .so.  A Makefile with the same
+flags lives in this directory for manual builds.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+_DIR = Path(__file__).resolve().parent
+SRC = _DIR / "src" / "parser.cc"
+LIB = _DIR / "libxflow_io.so"
+
+CXXFLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-Wall"]
+
+
+def build_if_needed(force: bool = False) -> Path:
+    if not force and LIB.exists() and LIB.stat().st_mtime >= SRC.stat().st_mtime:
+        return LIB
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(_DIR))
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", *CXXFLAGS, "-o", tmp, str(SRC)],
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+        os.replace(tmp, LIB)  # atomic: concurrent builders race benignly
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return LIB

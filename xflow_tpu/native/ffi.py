@@ -1,0 +1,122 @@
+"""ctypes bindings for the native parser (no pybind11 in this image —
+plain C ABI + ctypes, the same "embed as a library" shape the
+reference's C API intended, c_api.h:26-41).
+
+The shared library is built on demand with g++ (see build.py) and
+cached next to the sources.  Everything degrades gracefully: if no
+toolchain is available, ``available()`` is False and callers fall back
+to the pure-Python parser.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from xflow_tpu.io.batch import ParsedBlock
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def load_library() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            from xflow_tpu.native.build import build_if_needed
+
+            path = build_if_needed()
+            lib = ctypes.CDLL(str(path))
+        except Exception:
+            _load_failed = True
+            return None
+        lib.xf_murmur64.restype = ctypes.c_uint64
+        lib.xf_murmur64.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+        ]
+        lib.xf_parse_block.restype = ctypes.c_int64
+        lib.xf_parse_block.argtypes = [
+            ctypes.c_char_p,  # data
+            ctypes.c_int64,  # len
+            ctypes.c_int64,  # table_size
+            ctypes.c_int,  # hash_mode
+            ctypes.c_uint64,  # seed
+            ctypes.POINTER(ctypes.c_float),  # labels
+            ctypes.c_int64,  # max_rows
+            ctypes.POINTER(ctypes.c_int64),  # row_ptr
+            ctypes.POINTER(ctypes.c_int64),  # keys
+            ctypes.POINTER(ctypes.c_int32),  # slots
+            ctypes.POINTER(ctypes.c_float),  # vals
+            ctypes.c_int64,  # max_nnz
+            ctypes.POINTER(ctypes.c_int64),  # out_nnz
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def native_murmur64(data: bytes, seed: int = 0) -> int:
+    lib = load_library()
+    assert lib is not None, "native library unavailable"
+    return int(lib.xf_murmur64(data, len(data), seed))
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def native_parse_block(
+    data: bytes,
+    table_size: int,
+    hash_mode: bool = True,
+    hash_seed: int = 0,
+) -> ParsedBlock:
+    """Drop-in replacement for io.libffm.parse_block (parity enforced by
+    tests/test_native.py)."""
+    lib = load_library()
+    assert lib is not None, "native library unavailable"
+    # capacity bounds: every sample has one line; every feature token has
+    # exactly 2 of the block's ':' bytes
+    max_rows = data.count(b"\n") + 1
+    max_nnz = data.count(b":") // 2 + 1
+    labels = np.empty(max_rows, dtype=np.float32)
+    row_ptr = np.empty(max_rows + 1, dtype=np.int64)
+    keys = np.empty(max_nnz, dtype=np.int64)
+    slots = np.empty(max_nnz, dtype=np.int32)
+    vals = np.empty(max_nnz, dtype=np.float32)
+    out_nnz = np.zeros(1, dtype=np.int64)
+    n_rows = lib.xf_parse_block(
+        data,
+        len(data),
+        table_size,
+        1 if hash_mode else 0,
+        hash_seed,
+        _ptr(labels, ctypes.c_float),
+        max_rows,
+        _ptr(row_ptr, ctypes.c_int64),
+        _ptr(keys, ctypes.c_int64),
+        _ptr(slots, ctypes.c_int32),
+        _ptr(vals, ctypes.c_float),
+        max_nnz,
+        _ptr(out_nnz, ctypes.c_int64),
+    )
+    if n_rows < 0:
+        raise RuntimeError("native parser capacity overflow (bound bug)")
+    nnz = int(out_nnz[0])
+    return ParsedBlock(
+        labels=labels[:n_rows].copy(),
+        row_ptr=row_ptr[: n_rows + 1].copy(),
+        keys=keys[:nnz].copy(),
+        slots=slots[:nnz].copy(),
+        vals=vals[:nnz].copy(),
+    )

@@ -1,0 +1,232 @@
+// Native libffm block parser + MurmurHash64A feature hasher.
+//
+// TPU-native counterpart of the reference's C++ IO layer
+// (src/io/load_data_from_disk.cc:103-210, the fread block loader, and
+// the std::hash<string> feature hashing at :151 / io.h:53): host-side
+// text parsing is the throughput bottleneck when feeding an
+// accelerator from libffm text shards (SURVEY §7 hard part c), so the
+// tokenize+hash hot loop lives in C++ behind a C ABI consumed via
+// ctypes (no pybind11 dependency).
+//
+// Semantics mirror xflow_tpu/io/libffm.py::parse_block exactly —
+// parity is enforced by tests/test_native.py over toy, fuzzed, and
+// malformed inputs:
+//   * lines split on '\n'; tokens on spaces/tabs/CR
+//   * label = first token parsed as float (full consume), else line
+//     skipped; binarized y > 1e-7 -> 1
+//   * feature token must be fgid:fid:val with integer fgid; in hash
+//     mode fid is hashed as a string (MurmurHash64A, seed given) and
+//     val is DISCARDED (features binary, vals=1); in numeric mode fid
+//     must parse as integer and val as float, both kept
+//   * malformed tokens are skipped, not fatal
+//   * keys reduced modulo table_size
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t kMulm = 0xc6a4a7935bd1e995ULL;
+constexpr int kShift = 47;
+
+uint64_t murmur64a(const char* data, int64_t len, uint64_t seed) {
+  uint64_t h = seed ^ (static_cast<uint64_t>(len) * kMulm);
+  const int64_t nblocks = len / 8;
+  for (int64_t i = 0; i < nblocks; ++i) {
+    uint64_t k;
+    std::memcpy(&k, data + i * 8, 8);
+    k *= kMulm;
+    k ^= k >> kShift;
+    k *= kMulm;
+    h ^= k;
+    h *= kMulm;
+  }
+  const unsigned char* tail =
+      reinterpret_cast<const unsigned char*>(data + nblocks * 8);
+  uint64_t k = 0;
+  switch (len & 7) {
+    case 7: k |= static_cast<uint64_t>(tail[6]) << 48; [[fallthrough]];
+    case 6: k |= static_cast<uint64_t>(tail[5]) << 40; [[fallthrough]];
+    case 5: k |= static_cast<uint64_t>(tail[4]) << 32; [[fallthrough]];
+    case 4: k |= static_cast<uint64_t>(tail[3]) << 24; [[fallthrough]];
+    case 3: k |= static_cast<uint64_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k |= static_cast<uint64_t>(tail[1]) << 8; [[fallthrough]];
+    case 1:
+      k |= static_cast<uint64_t>(tail[0]);
+      h ^= k;
+      h *= kMulm;
+  }
+  h ^= h >> kShift;
+  h *= kMulm;
+  h ^= h >> kShift;
+  return h;
+}
+
+inline bool is_space(char c) {
+  // Python bytes.split() splits on these.
+  return c == ' ' || c == '\t' || c == '\r' || c == '\x0b' || c == '\f';
+}
+
+// Parse [p, end) fully as a float; false if empty or trailing junk.
+// Mirrors Python float(tok): leading/trailing whitespace already
+// stripped by tokenization.
+bool parse_float_full(const char* p, const char* end, float* out) {
+  if (p == end) return false;
+  // strtof accepts hex floats ("0x5") and "nan(...)"; Python float() does
+  // not — reject them for parity.
+  const char* q = p;
+  if (*q == '+' || *q == '-') ++q;
+  if (end - q >= 2 && q[0] == '0' && (q[1] == 'x' || q[1] == 'X')) return false;
+  if (std::memchr(p, '(', static_cast<size_t>(end - p)) != nullptr) return false;
+  // strtod needs NUL-terminated input; stack buffer for the common case,
+  // heap for pathological token lengths (Python float() has no limit).
+  char buf[64];
+  size_t n = static_cast<size_t>(end - p);
+  char* heap = nullptr;
+  char* s = buf;
+  if (n >= sizeof(buf)) {
+    heap = static_cast<char*>(std::malloc(n + 1));
+    if (heap == nullptr) return false;
+    s = heap;
+  }
+  std::memcpy(s, p, n);
+  s[n] = '\0';
+  char* parse_end = nullptr;
+  errno = 0;
+  // Parse as double then narrow, matching the Python parser's
+  // float(tok) -> float32 double rounding exactly (np.float32(float(tok))).
+  double v = std::strtod(s, &parse_end);
+  bool ok = (parse_end == s + n);
+  if (heap != nullptr) std::free(heap);
+  if (!ok) return false;
+  *out = static_cast<float>(v);
+  return true;
+}
+
+// Parse [p, end) fully as a base-10 integer (Python int(tok) semantics
+// minus underscores: optional sign, digits only).  Values outside int64
+// are rejected (the Python parser skips them too — see libffm.py's
+// range guards), never silently wrapped.
+bool parse_int_full(const char* p, const char* end, int64_t* out) {
+  if (p == end) return false;
+  bool neg = false;
+  if (*p == '+' || *p == '-') {
+    neg = (*p == '-');
+    ++p;
+    if (p == end) return false;
+  }
+  uint64_t v = 0;
+  constexpr uint64_t kMax = 0x7fffffffffffffffULL;  // int64 max
+  for (; p != end; ++p) {
+    if (*p < '0' || *p > '9') return false;
+    uint64_t d = static_cast<uint64_t>(*p - '0');
+    if (v > (kMax - d) / 10) return false;  // would overflow int64
+    v = v * 10 + d;
+  }
+  *out = neg ? -static_cast<int64_t>(v) : static_cast<int64_t>(v);
+  return true;
+}
+
+// fgid must fit int32 (slot arrays are int32 in both parsers).
+bool parse_fgid(const char* p, const char* end, int32_t* out) {
+  int64_t v;
+  if (!parse_int_full(p, end, &v)) return false;
+  if (v < INT32_MIN || v > INT32_MAX) return false;
+  *out = static_cast<int32_t>(v);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint64_t xf_murmur64(const char* data, int64_t len, uint64_t seed) {
+  return murmur64a(data, len, seed);
+}
+
+// Parses one text block.  Outputs are caller-allocated with capacities
+// max_rows / max_nnz; returns the number of parsed samples, or -1 if a
+// capacity would overflow (caller should re-bound and retry).
+// row_ptr has max_rows+1 slots; *out_nnz receives the total nnz.
+int64_t xf_parse_block(const char* data, int64_t len, int64_t table_size,
+                       int hash_mode, uint64_t seed, float* labels,
+                       int64_t max_rows, int64_t* row_ptr, int64_t* keys,
+                       int32_t* slots, float* vals, int64_t max_nnz,
+                       int64_t* out_nnz) {
+  int64_t n_rows = 0;
+  int64_t nnz = 0;
+  row_ptr[0] = 0;
+  const char* p = data;
+  const char* data_end = data + len;
+  while (p < data_end) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(data_end - p)));
+    if (line_end == nullptr) line_end = data_end;
+    const char* q = p;
+    p = line_end + 1;  // advance for next iteration
+
+    // tokenize: first token = label
+    while (q < line_end && is_space(*q)) ++q;
+    if (q == line_end) continue;  // blank line
+    const char* tok_end = q;
+    while (tok_end < line_end && !is_space(*tok_end)) ++tok_end;
+    float y;
+    if (!parse_float_full(q, tok_end, &y)) continue;  // bad label: skip line
+    if (n_rows == max_rows) return -1;
+    labels[n_rows] = (y > 1e-7f) ? 1.0f : 0.0f;
+
+    // feature tokens
+    q = tok_end;
+    while (q < line_end) {
+      while (q < line_end && is_space(*q)) ++q;
+      if (q == line_end) break;
+      const char* t_end = q;
+      while (t_end < line_end && !is_space(*t_end)) ++t_end;
+      // split fgid:fid:val — exactly 3 pieces
+      const char* c1 = static_cast<const char*>(
+          std::memchr(q, ':', static_cast<size_t>(t_end - q)));
+      if (c1 != nullptr) {
+        const char* c2 = static_cast<const char*>(
+            std::memchr(c1 + 1, ':', static_cast<size_t>(t_end - c1 - 1)));
+        if (c2 != nullptr &&
+            std::memchr(c2 + 1, ':', static_cast<size_t>(t_end - c2 - 1)) ==
+                nullptr) {
+          int32_t fgid;
+          if (parse_fgid(q, c1, &fgid)) {
+            if (hash_mode) {
+              if (nnz == max_nnz) return -1;
+              uint64_t h = murmur64a(c1 + 1, c2 - c1 - 1, seed);
+              keys[nnz] = static_cast<int64_t>(
+                  h % static_cast<uint64_t>(table_size));
+              slots[nnz] = fgid;
+              vals[nnz] = 1.0f;  // value field discarded: binary features
+              ++nnz;
+            } else {
+              int64_t fid;
+              float val;
+              if (parse_int_full(c1 + 1, c2, &fid) &&
+                  parse_float_full(c2 + 1, t_end, &val)) {
+                if (nnz == max_nnz) return -1;
+                int64_t k = fid % table_size;
+                if (k < 0) k += table_size;
+                keys[nnz] = k;
+                slots[nnz] = fgid;
+                vals[nnz] = val;
+                ++nnz;
+              }
+            }
+          }
+        }
+      }
+      q = t_end;
+    }
+    ++n_rows;
+    row_ptr[n_rows] = nnz;
+  }
+  *out_nnz = nnz;
+  return n_rows;
+}
+
+}  // extern "C"

@@ -27,7 +27,7 @@ import jax
 
 from xflow_tpu.config import Config
 from xflow_tpu.io.batch import Batch
-from xflow_tpu.io.loader import ShardLoader, shard_path
+from xflow_tpu.io.loader import ShardLoader, make_parse_fn, shard_path
 from xflow_tpu.models import make_model
 from xflow_tpu.optim import make_optimizer
 from xflow_tpu.parallel.mesh import make_mesh
@@ -96,7 +96,21 @@ class Trainer:
             block_mib=cfg.block_mib,
             hash_mode=cfg.hash_mode,
             hash_seed=cfg.seed,
+            parse_fn=make_parse_fn(
+                cfg.table_size,
+                cfg.hash_mode,
+                cfg.seed,
+                prefer_native=cfg.native_parser,
+            ),
         )
+
+    def _parse_workers(self) -> int:
+        w = self.cfg.parse_workers
+        if w < 0:
+            import os
+
+            w = max(1, min(6, (os.cpu_count() or 1) - 1))
+        return w
 
     def _my_shards(self, prefix: str) -> list[str]:
         shards = find_shards(prefix)
@@ -107,11 +121,19 @@ class Trainer:
     ) -> Iterator[tuple[Batch, int, int]]:
         """Yields (batch, shard_index, resume_offset) over one epoch."""
         shards = self._my_shards(self.cfg.train_path)
+        depth = self.cfg.prefetch_batches
         for si, path in enumerate(shards):
             if si < start_shard:
                 continue
             offset = start_offset if si == start_shard else 0
-            for batch, resume in self._loader(path).iter_batches(offset):
+            loader = self._loader(path)
+            workers = self._parse_workers()
+            it = (
+                loader.prefetch(depth, offset, workers)
+                if depth
+                else loader.iter_batches(offset, workers)
+            )
+            for batch, resume in it:
                 yield batch, si, resume
 
     # -- training ----------------------------------------------------------
@@ -176,11 +198,14 @@ class Trainer:
         if out_path and self.host == 0:
             pred_file = open(out_path, "w")
         try:
+            workers = self._parse_workers()
             for path in self._my_shards(cfg.test_path):
                 # Reference predict uses doubled block size (lr_worker.cc:80).
                 loader = self._loader(path)
                 loader.block_bytes = (cfg.block_mib * 2) << 20
-                for batch, _ in loader.iter_batches():
+                for batch, _ in loader.prefetch(
+                    cfg.prefetch_batches, parse_workers=workers
+                ):
                     arrays = self.step.put_batch(batch)
                     pctr = np.asarray(jax.device_get(self.step.predict(self.state, arrays)))
                     acc.add(batch.labels, pctr, batch.weights)
