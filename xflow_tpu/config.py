@@ -16,8 +16,10 @@ from typing import Any
 
 @dataclasses.dataclass
 class Config:
-    # -- model selection (reference: main.cc:27-45, argv[3] '0'/'1'/'2') --
-    model: str = "lr"  # one of {"lr", "fm", "mvm"}
+    # -- model selection (reference: main.cc:27-45, argv[3] '0'/'1'/'2';
+    # "ffm" and "wide_deep" are capability extensions beyond the
+    # reference's zoo, from BASELINE.json's target configs) --
+    model: str = "lr"  # {"lr", "fm", "mvm", "ffm", "wide_deep"}
 
     # -- data (reference: argv[1]/argv[2] shard prefixes, lr_worker.cc:210) --
     train_path: str = ""
@@ -43,6 +45,11 @@ class Config:
     table_size_log2: int = 22
     # Latent factor count for FM/MVM (reference: ftrl.h:16 v_dim=10).
     v_dim: int = 10
+    # FFM per-field latent dim (its v table is max_fields * ffm_v_dim wide).
+    ffm_v_dim: int = 4
+    # Wide&deep embedding dim and hidden layer width.
+    emb_dim: int = 8
+    hidden_dim: int = 64
     # Static padded features-per-sample inside the jit step.  Samples with
     # more features than this are truncated (reference has no limit —
     # features-per-sample is whatever the text line holds).
@@ -74,6 +81,15 @@ class Config:
     # -- parallelism --
     # Devices in the 1-D mesh ('data' axis).  0 = use all available.
     num_devices: int = 0
+
+    # -- observability (SURVEY §5: reference has stdout only) --
+    # JSONL file receiving one structured record per epoch / eval.
+    metrics_out: str = ""
+    # Capture a jax.profiler trace (viewable in TensorBoard/Perfetto) of
+    # profile_steps training steps starting at step profile_start_step.
+    profile_dir: str = ""
+    profile_steps: int = 5
+    profile_start_step: int = 10
 
     # -- eval / artifacts --
     # Rank 0 dumps "(label, pctr)" prediction lines (reference:
@@ -119,7 +135,7 @@ class Config:
     param_dtype: str = "float32"
 
     def __post_init__(self) -> None:
-        if self.model not in ("lr", "fm", "mvm"):
+        if self.model not in ("lr", "fm", "mvm", "ffm", "wide_deep"):
             raise ValueError(f"unknown model {self.model!r}")
         if self.optimizer not in ("ftrl", "sgd"):
             raise ValueError(f"unknown optimizer {self.optimizer!r}")

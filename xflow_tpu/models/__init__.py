@@ -1,11 +1,14 @@
-from xflow_tpu.models.base import Model, TableSpec
+from xflow_tpu.models.base import AutodiffModel, Model, TableSpec
 from xflow_tpu.models.lr import LRModel
 from xflow_tpu.models.fm import FMModel
 from xflow_tpu.models.mvm import MVMModel
+from xflow_tpu.models.ffm import FFMModel
+from xflow_tpu.models.wide_deep import WideDeepModel
 
 
 def make_model(cfg) -> Model:
-    # Reference model dispatch: main.cc:27-45, argv[3] '0'→LR '1'→FM '2'→MVM.
+    # Reference model dispatch: main.cc:27-45, argv[3] '0'→LR '1'→FM '2'→MVM;
+    # ffm/wide_deep are extensions (BASELINE.json target configs).
     if cfg.model == "lr":
         return LRModel()
     if cfg.model == "fm":
@@ -16,7 +19,30 @@ def make_model(cfg) -> Model:
             v_init_scale=cfg.v_init_scale,
             max_fields=cfg.max_fields,
         )
+    if cfg.model == "ffm":
+        return FFMModel(
+            v_dim=cfg.ffm_v_dim,
+            max_fields=cfg.max_fields,
+            v_init_scale=cfg.v_init_scale,
+        )
+    if cfg.model == "wide_deep":
+        return WideDeepModel(
+            emb_dim=cfg.emb_dim,
+            hidden=cfg.hidden_dim,
+            max_fields=cfg.max_fields,
+            v_init_scale=cfg.v_init_scale,
+        )
     raise ValueError(f"unknown model {cfg.model!r}")
 
 
-__all__ = ["Model", "TableSpec", "LRModel", "FMModel", "MVMModel", "make_model"]
+__all__ = [
+    "AutodiffModel",
+    "Model",
+    "TableSpec",
+    "LRModel",
+    "FMModel",
+    "MVMModel",
+    "FFMModel",
+    "WideDeepModel",
+    "make_model",
+]

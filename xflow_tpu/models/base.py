@@ -50,3 +50,26 @@ class Model(Protocol):
         self, rows: dict[str, jax.Array], batch: BatchArrays
     ) -> dict[str, jax.Array]:
         ...
+
+
+class AutodiffModel:
+    """Base for models without reference gradient quirks (FFM,
+    wide&deep): define ``logit`` only — the train step derives
+    per-occurrence table gradients and dense-parameter gradients with
+    jax.grad.  May also own dense (non-table, replicated) parameters,
+    e.g. MLP weights, via ``dense_init``."""
+
+    #: marker the train step dispatches on
+    autodiff = True
+
+    def dense_init(self, rng: jax.Array) -> dict:
+        """Replicated dense parameter pytree ({} if none)."""
+        return {}
+
+    def logit(
+        self,
+        rows: dict[str, jax.Array],
+        batch: BatchArrays,
+        dense: dict | None = None,
+    ) -> jax.Array:
+        raise NotImplementedError

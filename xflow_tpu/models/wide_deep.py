@@ -1,0 +1,81 @@
+"""Wide & Deep: sparse linear ("wide") + embedding MLP ("deep").
+
+Capability extension beyond the reference's model zoo (BASELINE.json
+configs list "Wide-and-deep (LR + 2-layer MLP) on Criteo-Kaggle").
+
+* Wide: the LR weight table, FTRL-updated like every table.
+* Deep: an embedding table [T, emb_dim]; each sample's embeddings are
+  field-summed into max_fields buckets (same one-hot trick as MVM, so
+  variable features-per-field work under static shapes), concatenated
+  to [max_fields * emb_dim], and fed through a 2-layer ReLU MLP whose
+  weights are replicated dense parameters.
+
+Autodiff model: table gradients and MLP gradients both come from
+jax.grad of the batch loss.  The dense MLP parameters are updated with
+plain SGD (config.sgd_lr) regardless of the table optimizer — FTRL's
+per-coordinate L1 shrinkage is for sparse one-hot features, not dense
+hidden layers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.models.base import AutodiffModel, BatchArrays, TableSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class WideDeepModel(AutodiffModel):
+    emb_dim: int = 8
+    hidden: int = 64
+    max_fields: int = 32
+    v_init_scale: float = 1e-2
+    name: str = "wide_deep"
+
+    def tables(self) -> list[TableSpec]:
+        return [
+            TableSpec("w", 1, lambda rng, shape: jnp.zeros(shape, jnp.float32)),
+            TableSpec(
+                "emb",
+                self.emb_dim,
+                lambda rng, shape: (
+                    jax.random.normal(rng, shape, jnp.float32) * self.v_init_scale
+                ),
+            ),
+        ]
+
+    def dense_init(self, rng: jax.Array) -> dict:
+        k1, k2 = jax.random.split(rng)
+        in_dim = self.max_fields * self.emb_dim
+        # He init for the ReLU layer, small linear head.
+        return {
+            "w1": jax.random.normal(k1, (in_dim, self.hidden), jnp.float32)
+            * jnp.sqrt(2.0 / in_dim),
+            "b1": jnp.zeros((self.hidden,), jnp.float32),
+            "w2": jax.random.normal(k2, (self.hidden, 1), jnp.float32)
+            * jnp.sqrt(1.0 / self.hidden),
+            "b2": jnp.zeros((1,), jnp.float32),
+        }
+
+    def logit(
+        self,
+        rows: dict[str, jax.Array],
+        batch: BatchArrays,
+        dense: dict | None = None,
+    ) -> jax.Array:
+        assert dense is not None, "wide_deep requires dense MLP params"
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        wide = jnp.sum(rows["w"][..., 0] * x, axis=-1)
+
+        onehot = jax.nn.one_hot(
+            batch["slots"], self.max_fields, dtype=x.dtype
+        )  # [B, K, F]; out-of-range fields drop out
+        embx = rows["emb"] * x[..., None]  # [B, K, E]
+        field_emb = jnp.einsum("bkf,bke->bfe", onehot, embx)  # [B, F, E]
+        h = field_emb.reshape(field_emb.shape[0], -1)  # [B, F*E]
+        h = jax.nn.relu(h @ dense["w1"] + dense["b1"])
+        deep = (h @ dense["w2"] + dense["b2"])[:, 0]
+        return wide + deep

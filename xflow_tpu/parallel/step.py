@@ -33,16 +33,21 @@ from xflow_tpu.parallel.mesh import batch_sharding, table_sharding
 from xflow_tpu.utils.metrics import logloss, sigmoid_ref
 
 # State pytree:
-# {"tables": {name: {"param": [T,D], <aux>: [T,D]...}}, "step": int32 scalar}
+# {"tables": {name: {"param": [T,D], <aux>: [T,D]...}},
+#  "dense": {name: array} (replicated; {} for table-only models),
+#  "step": int32 scalar}
 State = dict[str, Any]
 
 
 def init_state(model: Model, optimizer: Optimizer, cfg: Config, mesh) -> State:
-    """Create sharded zero/random-initialized tables.
+    """Create sharded zero/random-initialized tables (plus replicated
+    dense params for models that have them).
 
     v-table random init reproduces the reference's lazy server-side
     N(0,1)*1e-2 (ftrl.h:113-120) eagerly; see optim/ftrl.py.
     """
+    from xflow_tpu.parallel.mesh import replicated
+
     sharding = table_sharding(mesh)
     rng = jax.random.PRNGKey(cfg.seed)
     tables: dict[str, dict[str, jax.Array]] = {}
@@ -56,7 +61,13 @@ def init_state(model: Model, optimizer: Optimizer, cfg: Config, mesh) -> State:
         for aux_name, aux in optimizer.init_aux(param).items():
             entry[aux_name] = jax.device_put(aux, sharding)
         tables[spec.name] = entry
-    return {"tables": tables, "step": jnp.zeros((), jnp.int32)}
+    dense = {}
+    if hasattr(model, "dense_init"):
+        dense = jax.tree.map(
+            lambda a: jax.device_put(a, replicated(mesh)),
+            model.dense_init(jax.random.fold_in(rng, 1000)),
+        )
+    return {"tables": tables, "dense": dense, "step": jnp.zeros((), jnp.int32)}
 
 
 def batch_to_arrays(batch: Batch) -> BatchArrays:
@@ -111,20 +122,56 @@ class TrainStep:
 
     # -- compiled bodies ---------------------------------------------------
 
+    def _logit(
+        self, rows: dict[str, jax.Array], batch: BatchArrays, dense: dict
+    ) -> jax.Array:
+        if getattr(self.model, "autodiff", False):
+            return self.model.logit(rows, batch, dense)
+        return self.model.logit(rows, batch)
+
     def _train_impl(
         self, state: State, batch: BatchArrays
     ) -> tuple[State, dict[str, jax.Array]]:
         cfg = self.cfg
         tables = state["tables"]
+        dense = state["dense"]
         rows = self._gather_model_rows(tables, batch)
-        logit = self.model.logit(rows, batch)
-        pctr = sigmoid_ref(logit)
         num_real = jnp.maximum(jnp.sum(batch["weights"]), 1.0)
-        # Residual "loss" exactly as the reference names it
-        # (lr_worker.cc:121-143): sigma(wx) - y, zeroed for pad examples,
-        # pre-divided by batch size for the mean-gradient semantics.
-        residual = (pctr - batch["labels"]) * batch["weights"] / num_real
-        grad_occ = self.model.grad_logit(rows, batch)
+        new_dense = dense
+        if getattr(self.model, "autodiff", False):
+            # Autodiff path (FFM, wide&deep — no reference gradient quirks):
+            # stable BCE-with-logits; d/dlogit = sigmoid(logit) - y, the
+            # same residual semantics as the explicit path.
+            def loss_fn(rows_, dense_):
+                logit_ = self.model.logit(rows_, batch, dense_)
+                nll = jax.nn.softplus(logit_) - batch["labels"] * logit_
+                return (
+                    jnp.sum(nll * batch["weights"]) / num_real,
+                    logit_,
+                )
+
+            (_, logit), (grad_rows, grad_dense) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(rows, dense)
+            pctr = sigmoid_ref(logit)
+            occ_grads = grad_rows  # already include residual and 1/num_real
+            if dense:
+                new_dense = jax.tree.map(
+                    lambda p, g: p - cfg.sgd_lr * g, dense, grad_dense
+                )
+        else:
+            logit = self.model.logit(rows, batch)
+            pctr = sigmoid_ref(logit)
+            # Residual "loss" exactly as the reference names it
+            # (lr_worker.cc:121-143): sigma(wx) - y, zeroed for pad
+            # examples, pre-divided by batch size for the mean-gradient
+            # semantics.
+            residual = (pctr - batch["labels"]) * batch["weights"] / num_real
+            grad_logit = self.model.grad_logit(rows, batch)
+            occ_grads = {
+                name: g * residual[:, None, None]
+                for name, g in grad_logit.items()
+            }
 
         sentinel = jnp.int32(cfg.table_size)
         keys_eff = jnp.where(
@@ -134,7 +181,7 @@ class TrainStep:
         new_tables = {}
         for name, table in tables.items():
             d = table["param"].shape[-1]
-            flat_g = (grad_occ[name] * residual[:, None, None]).reshape(-1, d)
+            flat_g = occ_grads[name].reshape(-1, d)
             if cfg.update_mode == "dense":
                 # Scatter-add consolidates duplicate keys; the optimizer
                 # recurrence then runs elementwise over the full table —
@@ -159,10 +206,14 @@ class TrainStep:
             "logloss": logloss(batch["labels"], pctr, batch["weights"]),
             "count": jnp.sum(batch["weights"]),
         }
-        new_state = {"tables": new_tables, "step": state["step"] + 1}
+        new_state = {
+            "tables": new_tables,
+            "dense": new_dense,
+            "step": state["step"] + 1,
+        }
         return new_state, metrics
 
     def _predict_impl(self, state: State, batch: BatchArrays) -> jax.Array:
         """pctr per example (reference calculate_pctr, lr_worker.cc:46-61)."""
         rows = self._gather_model_rows(state["tables"], batch)
-        return sigmoid_ref(self.model.logit(rows, batch))
+        return sigmoid_ref(self._logit(rows, batch, state["dense"]))

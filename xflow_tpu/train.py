@@ -37,7 +37,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--test", dest="test_path", help="test shard prefix")
     p.add_argument(
         "--model",
-        choices=["lr", "fm", "mvm", "0", "1", "2"],
+        choices=["lr", "fm", "mvm", "ffm", "wide_deep", "0", "1", "2"],
         help="model family (numeric aliases match the reference argv[3])",
     )
     p.add_argument("--epochs", type=int)
@@ -45,6 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, dest="batch_size")
     p.add_argument("--table-size-log2", type=int, dest="table_size_log2")
     p.add_argument("--v-dim", type=int, dest="v_dim")
+    p.add_argument("--ffm-v-dim", type=int, dest="ffm_v_dim")
+    p.add_argument("--emb-dim", type=int, dest="emb_dim")
+    p.add_argument("--hidden-dim", type=int, dest="hidden_dim")
     p.add_argument("--max-nnz", type=int, dest="max_nnz")
     p.add_argument("--max-fields", type=int, dest="max_fields")
     p.add_argument("--block-mib", type=int, dest="block_mib")
@@ -57,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-devices", type=int, dest="num_devices")
     p.add_argument("--no-hash", action="store_true", help="numeric fids, keep values")
     p.add_argument("--pred-out", dest="pred_out")
+    p.add_argument("--metrics-out", dest="metrics_out")
+    p.add_argument("--profile-dir", dest="profile_dir")
+    p.add_argument("--profile-steps", type=int, dest="profile_steps")
+    p.add_argument("--profile-start-step", type=int, dest="profile_start_step")
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir")
     p.add_argument(
         "--checkpoint-every-steps", type=int, dest="checkpoint_every_steps"

@@ -80,6 +80,13 @@ class Trainer:
         # restore(), consumed by the first train_epoch() after it.
         self._resume_cursor: tuple[int, int] = (0, 0)
         self._log = log if log is not None else lambda s: print(s, file=sys.stderr)
+        self.metrics_logger = None
+        if cfg.metrics_out and jax.process_index() == 0:
+            from xflow_tpu.utils.logging import MetricsLogger
+
+            self.metrics_logger = MetricsLogger(cfg.metrics_out)
+        self._profiled = False
+        self._global_steps = 0  # across epochs; drives the profile trigger
         # Multi-host: each process reads its own shard subset.
         self.host = jax.process_index()
         self.num_hosts = jax.process_count()
@@ -143,17 +150,38 @@ class Trainer:
         t0 = time.time()
         steps = 0
         device_metrics = []  # fetched once at epoch end to keep dispatch async
+        profiling = False
         for batch, shard_idx, resume in self.iter_train_batches(
             start_shard, start_offset
         ):
+            if (
+                cfg.profile_dir
+                and not self._profiled
+                and self._global_steps >= cfg.profile_start_step
+                and not profiling
+            ):
+                jax.profiler.start_trace(cfg.profile_dir)
+                profiling = True
+                profile_end = self._global_steps + cfg.profile_steps
             arrays = self.step.put_batch(batch)
             self.state, metrics = self.step.train(self.state, arrays)
             steps += 1
+            self._global_steps += 1
             device_metrics.append(metrics)
+            if profiling and self._global_steps >= profile_end:
+                jax.device_get(metrics["logloss"])  # flush pending work
+                jax.profiler.stop_trace()
+                profiling = False
+                self._profiled = True
             if cfg.checkpoint_dir and cfg.checkpoint_every_steps and (
                 steps % cfg.checkpoint_every_steps == 0
             ):
                 self.save(shard_idx, resume)
+        if profiling:  # epoch ended inside the profile window
+            if device_metrics:
+                jax.device_get(device_metrics[-1]["logloss"])  # flush
+            jax.profiler.stop_trace()
+            self._profiled = True
         host_metrics = jax.device_get(device_metrics)
         seen = float(sum(m["count"] for m in host_metrics))
         ll_sum = float(
@@ -178,6 +206,8 @@ class Trainer:
             self._resume_cursor = (0, 0)
             stats = self.train_epoch(start_shard, start_offset)
             history.append(stats)
+            if self.metrics_logger is not None:
+                self.metrics_logger.log("train_epoch", stats)
             if self.epoch % 30 == 0 or self.epoch == self.cfg.epochs - 1:
                 self._log(
                     f"epoch {self.epoch}: logloss={stats['train_logloss']:.6f} "
@@ -247,6 +277,8 @@ class Trainer:
         pos = int(acc.pairs()[0].sum()) if n else 0
         result = {"logloss": ll, "auc": auc, "examples": n, "tp": pos, "fp": n - pos}
         self._log(f"logloss: {ll:.6f}\tauc = {auc:.6f}\ttp = {pos} fp = {n - pos}")
+        if self.metrics_logger is not None:
+            self.metrics_logger.log("eval", result)
         return result
 
     # -- checkpointing -----------------------------------------------------

@@ -44,6 +44,10 @@ def save_checkpoint(
                 fname = f"{tname}.{aname}.npy"
                 np.save(os.path.join(tmp, fname), np.asarray(jax.device_get(arr)))
                 arrays[f"{tname}/{aname}"] = fname
+        for dname, arr in state.get("dense", {}).items():
+            fname = f"dense.{dname}.npy"
+            np.save(os.path.join(tmp, fname), np.asarray(jax.device_get(arr)))
+            arrays[f"dense/{dname}"] = fname
         manifest = {
             "step": step,
             "arrays": arrays,
@@ -107,10 +111,22 @@ def load_checkpoint(
                     f"checkpoint array {key} shape {host.shape} != state {arr.shape}"
                 )
             new_tables[tname][aname] = jax.device_put(host, arr.sharding)
+    new_dense = {}
+    for dname, arr in state.get("dense", {}).items():
+        key = f"dense/{dname}"
+        if key not in manifest["arrays"]:
+            raise ValueError(f"checkpoint {path} missing array {key}")
+        host = np.load(os.path.join(path, manifest["arrays"][key]))
+        if host.shape != arr.shape:
+            raise ValueError(
+                f"checkpoint array {key} shape {host.shape} != state {arr.shape}"
+            )
+        new_dense[dname] = jax.device_put(host, arr.sharding)
     import jax.numpy as jnp
 
     new_state = {
         "tables": new_tables,
+        "dense": new_dense,
         "step": jnp.asarray(manifest["step"], jnp.int32),
     }
     return new_state, manifest["cursor"]

@@ -1,0 +1,34 @@
+"""Structured metrics logging — a SURVEY §5 observability gap filled.
+
+The reference logs to stdout only (rank banner, epoch every 30, final
+logloss/AUC — lr_worker.cc:202,209, base.h:101-108).  Here every epoch
+and eval emits a JSON line with a monotonic timestamp so runs are
+machine-comparable; stdout keeps the human-readable reference-style
+lines.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, IO
+
+
+class MetricsLogger:
+    def __init__(self, path: str):
+        self._f: IO[str] = open(path, "a", buffering=1)
+        self._t0 = time.time()
+
+    def log(self, kind: str, record: dict[str, Any]) -> None:
+        row = {"t": round(time.time() - self._t0, 3), "kind": kind}
+        row.update(record)
+        self._f.write(json.dumps(row, sort_keys=True) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
