@@ -12,7 +12,12 @@ BASELINE.md terms: value = accelerator examples/sec, vs_baseline =
 accelerator/CPU-host throughput ratio.
 
 Shapes model Criteo-style CTR: 39 features/sample padded to 40,
-batch 65536 (throughput saturates there on v5e), 2^24-row hashed table.
+batch 131072 (throughput saturates there on v5e: measured 0.97M ex/s at
+B=16k, 1.34M at 64k, 1.40M at 128k, 1.26M at 256k), 2^24-row hashed
+table.  The step is slice-count-bound: XLA TPU gather/scatter cost
+~8-10ns per gathered/scattered slice regardless of slice width or table
+size (measured on v5e), so B*nnz slices set the floor; see
+docs/PERF.md for the full measurement log.
 """
 
 from __future__ import annotations
@@ -97,20 +102,20 @@ def main() -> None:
         model="lr",
         optimizer="ftrl",
         table_size_log2=24,
-        batch_size=65536,
+        batch_size=131072,
         max_nnz=40,
         num_devices=1,
     )
     accel = [d for d in jax.devices() if d.platform != "cpu"]
     cpu = jax.devices("cpu")
 
-    batches = make_batches(cfg, 8)
+    batches = make_batches(cfg, 4)
     if accel:
         step, state = build(accel, cfg)
         _, accel_eps = run(step, state, batches, iters=20)
     else:
         step, state = build(cpu, cfg)
-        _, accel_eps = run(step, state, batches, iters=10)
+        _, accel_eps = run(step, state, batches, iters=6)
 
     # CPU proxy baseline, smaller table/iters to keep runtime bounded
     cpu_cfg = cfg.replace(table_size_log2=22, batch_size=16384)

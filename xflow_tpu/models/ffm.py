@@ -60,7 +60,9 @@ class FFMModel(AutodiffModel):
 
         v = rows["v"].reshape(b, k, f, d)  # per-key field-specific vectors
         slot = jnp.clip(batch["slots"], 0, f - 1)  # [B, K]
-        valid = (batch["slots"] < f) & (batch["mask"] > 0)  # [B, K]
+        valid = (
+            (batch["slots"] >= 0) & (batch["slots"] < f) & (batch["mask"] > 0)
+        )  # [B, K] — negative field ids dropped, matching MVM/Wide&Deep
 
         # v_for[b, i, j, :] = v[key_i, field_of_j, :] — gather i's latent
         # vector specific to j's field, for every ordered pair (i, j).
