@@ -1,0 +1,98 @@
+"""Perf probe: MXU one-hot matmul as the gather/scatter path for a small
+"hot" table (frequency-partitioned embedding).
+
+If XLA fuses the one-hot (iota==key compare) into the matmul operand
+without materializing [M, H], then hot-key gather ~= A @ w_hot and
+hot-key scatter ~= A^T @ g run at MXU speed, removing those occurrences
+from the per-slice DMA budget entirely.
+
+Run: python scripts/probe_hot.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+H = 4096           # hot table rows
+M = 131072 * 40    # total occurrences per step
+HOT_FRAC = 0.3
+MH = int(M * HOT_FRAC)
+
+
+def timed(fn, *args, iters=10, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    dev = [d for d in jax.devices() if d.platform != "cpu"][0]
+    rng = np.random.default_rng(0)
+    keys = jax.device_put(jnp.asarray(rng.integers(0, H, MH).astype(np.int32)), dev)
+    g = jax.device_put(jnp.ones((MH,), jnp.float32), dev)
+    w = jax.device_put(jnp.asarray(rng.normal(size=(H, 1)).astype(np.float32)), dev)
+    wv = jax.device_put(jnp.asarray(rng.normal(size=(H, 16)).astype(np.float32)), dev)
+
+    CH = 32768  # chunk rows per one-hot block
+
+    @jax.jit
+    def gather_dma(w, k):
+        return w.at[k].get(mode="clip").sum()
+
+    @jax.jit
+    def gather_mxu(w, k):
+        # chunked one-hot @ w; rely on XLA fusing the iota-compare operand
+        def body(c, kc):
+            oh = (kc[:, None] == jnp.arange(H, dtype=jnp.int32)[None, :])
+            return c, (oh.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16))
+        _, out = jax.lax.scan(body, 0, k.reshape(-1, CH))
+        return out.sum()
+
+    @jax.jit
+    def scatter_dma(w, k, g):
+        return jnp.zeros_like(w).at[k].add(g[:, None], mode="drop")
+
+    @jax.jit
+    def scatter_mxu(w, k, g):
+        def body(acc, xs):
+            kc, gc = xs
+            oh = (kc[:, None] == jnp.arange(H, dtype=jnp.int32)[None, :])
+            return acc + (oh.astype(jnp.bfloat16).T @ gc[:, None].astype(jnp.bfloat16)).astype(jnp.float32), None
+        acc, _ = jax.lax.scan(
+            body, jnp.zeros((H, 1), jnp.float32),
+            (k.reshape(-1, CH), g.reshape(-1, CH)),
+        )
+        return acc
+
+    print(f"MH={MH} hot occurrences, H={H} rows, chunk={CH}")
+    print(f"gather  DMA: {timed(gather_dma, w, keys):7.2f} ms")
+    print(f"gather  MXU: {timed(gather_mxu, w, keys):7.2f} ms")
+    print(f"scatter DMA: {timed(scatter_dma, w, keys, g):7.2f} ms")
+    print(f"scatter MXU: {timed(scatter_mxu, w, keys, g):7.2f} ms")
+
+    # wider rows (FM v table, D=16): matmul gets D columns for free-ish
+    @jax.jit
+    def gather_mxu_wide(w, k):
+        def body(c, kc):
+            oh = (kc[:, None] == jnp.arange(H, dtype=jnp.int32)[None, :])
+            return c, (oh.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16))
+        _, out = jax.lax.scan(body, 0, k.reshape(-1, CH))
+        return out.sum()
+
+    @jax.jit
+    def gather_dma_wide(w, k):
+        return w.at[k].get(mode="clip").sum()
+
+    print(f"gather  DMA D=16: {timed(gather_dma_wide, wv, keys):7.2f} ms")
+    print(f"gather  MXU D=16: {timed(gather_mxu_wide, wv, keys):7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Perf probe 2: two-level one-hot MXU gather/scatter for the hot table.
+
+key = hi*h2 + lo.  Gather: ((oh_hi @ W) * oh_lo).sum(-1) where W is
+[h1, h2] (D=1 case) — traffic is M*(h1+h2) instead of M*H.
+Scatter: W += oh_hi^T @ (g[:,None] * oh_lo)  — one [h1,M]@[M,h2] matmul.
+
+Run: python scripts/probe_hot2.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+M = 131072 * 40
+HOT_FRAC = 0.3
+MH = int(M * HOT_FRAC)
+
+
+def timed(fn, *args, iters=10, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def run(h1, h2):
+    H = h1 * h2
+    dev = [d for d in jax.devices() if d.platform != "cpu"][0]
+    rng = np.random.default_rng(0)
+    keys = jax.device_put(jnp.asarray(rng.integers(0, H, MH).astype(np.int32)), dev)
+    g = jax.device_put(jnp.ones((MH,), jnp.float32), dev)
+    W = jax.device_put(jnp.asarray(rng.normal(size=(h1, h2)).astype(np.float32)), dev)
+
+    @jax.jit
+    def gather2(W, k):
+        hi = k // h2
+        lo = k % h2
+        oh_hi = (hi[:, None] == jnp.arange(h1, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
+        oh_lo = (lo[:, None] == jnp.arange(h2, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
+        rows = jnp.dot(oh_hi, W.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)  # [M, h2]
+        return (rows * oh_lo).sum(-1).sum()
+
+    @jax.jit
+    def scatter2(k, g):
+        hi = k // h2
+        lo = k % h2
+        oh_hi = (hi[:, None] == jnp.arange(h1, dtype=jnp.int32)[None, :]).astype(jnp.bfloat16)
+        oh_lo = (lo[:, None] == jnp.arange(h2, dtype=jnp.int32)[None, :]).astype(jnp.float32)
+        glo = (g[:, None] * oh_lo).astype(jnp.bfloat16)  # [M, h2]
+        return jnp.dot(oh_hi.T, glo, preferred_element_type=jnp.float32)
+
+    @jax.jit
+    def gather_dma(W, k):
+        return W.reshape(-1, 1).at[k].get(mode="clip").sum()
+
+    @jax.jit
+    def scatter_dma(W, k, g):
+        return jnp.zeros((H, 1), jnp.float32).at[k].add(g[:, None], mode="drop")
+
+    print(f"H={H} ({h1}x{h2}), MH={MH}")
+    print(f"  gather  2-level MXU: {timed(gather2, W, keys):7.2f} ms   DMA: {timed(gather_dma, W, keys):7.2f} ms")
+    print(f"  scatter 2-level MXU: {timed(scatter2, keys, g):7.2f} ms   DMA: {timed(scatter_dma, W, keys, g):7.2f} ms")
+
+
+if __name__ == "__main__":
+    run(64, 64)
+    run(128, 128)
+    run(128, 512)
