@@ -1,0 +1,265 @@
+"""Hot-table path end-to-end: steering, frequency remap, and the
+invariant that hot-enabled training is numerically the same model as
+DMA-only training (the remap is a permutation of row placement and the
+f32 MXU gather is exact — docs/PERF.md)."""
+
+import numpy as np
+import pytest
+
+from xflow_tpu.config import Config
+from xflow_tpu.io.batch import split_hot
+from xflow_tpu.io import freq
+from xflow_tpu.trainer import Trainer
+
+
+# -- unit: steering ---------------------------------------------------------
+
+
+def test_split_hot_steering():
+    # row 0: 3 hot (capacity 2 -> one spills cold), 1 cold
+    # row 1: all cold;  row 2: padding-only tail
+    keys = np.array([[1, 2, 3, 50], [60, 70, 80, 90], [5, 0, 0, 0]], np.int32)
+    slots = np.arange(12, dtype=np.int32).reshape(3, 4)
+    vals = np.ones((3, 4), np.float32) * 2.0
+    mask = np.array(
+        [[1, 1, 1, 1], [1, 1, 1, 1], [1, 0, 0, 0]], np.float32
+    )
+    out = split_hot(keys, slots, vals, mask, hot_size=10, hot_nnz=2)
+    np.testing.assert_array_equal(out["hot_keys"], [[1, 2], [0, 0], [5, 0]])
+    np.testing.assert_array_equal(out["hot_mask"], [[1, 1], [0, 0], [1, 0]])
+    # cold section: row 0 gets the spilled hot key 3 plus 50
+    np.testing.assert_array_equal(
+        out["keys"], [[3, 50], [60, 70], [0, 0]]
+    )
+    np.testing.assert_array_equal(out["mask"], [[1, 1], [1, 1], [0, 0]])
+    # slots travel with their entries
+    np.testing.assert_array_equal(out["hot_slots"], [[0, 1], [0, 0], [8, 0]])
+    np.testing.assert_array_equal(out["slots"], [[2, 3], [4, 5], [0, 0]])
+    # cold truncation: row 1 had 4 cold entries but capacity 2
+    assert out["keys"].shape == (3, 2)
+
+
+def test_split_hot_no_entry_lost_when_capacity_suffices():
+    # each row: 3 hot keys (< 30), 3 cold keys (>= 30), 2 pad entries;
+    # capacities kh=4, kc=8-4=4 suffice, so no entry may be dropped
+    rng = np.random.default_rng(0)
+    hot_part = rng.integers(0, 30, (16, 3))
+    cold_part = rng.integers(30, 100, (16, 3))
+    pad = np.zeros((16, 2), dtype=np.int64)
+    keys = np.concatenate([hot_part, cold_part, pad], axis=1).astype(np.int32)
+    mask = np.concatenate(
+        [np.ones((16, 6)), np.zeros((16, 2))], axis=1
+    ).astype(np.float32)
+    vals = rng.random((16, 8)).astype(np.float32) * mask
+    slots = rng.integers(0, 5, (16, 8)).astype(np.int32)
+    out = split_hot(keys, slots, vals, mask, hot_size=30, hot_nnz=4)
+    total_in = int(mask.sum())
+    total_out = int(out["hot_mask"].sum() + out["mask"].sum())
+    assert total_in == total_out
+    # multiset of (key, val) pairs preserved
+    def pairs(k, v, m):
+        sel = m > 0
+        return sorted(zip(k[sel].tolist(), v[sel].tolist()))
+
+    got = sorted(
+        pairs(out["hot_keys"], out["hot_vals"], out["hot_mask"])
+        + pairs(out["keys"], out["vals"], out["mask"])
+    )
+    assert got == pairs(keys, vals * mask, mask)
+
+
+# -- unit: frequency remap --------------------------------------------------
+
+
+def test_build_remap_is_permutation_capturing_head():
+    rng = np.random.default_rng(1)
+    t = 1 << 12
+    # zipfian occurrences
+    occ = (rng.zipf(1.2, size=200_000) - 1) % t
+    counts = np.bincount(occ, minlength=t).astype(np.int64)
+    h = 256
+    remap = freq.build_remap(counts, h)
+    assert sorted(remap.tolist()) == list(range(t))  # bijection
+    # the H most frequent keys all land in [0, H)
+    top = np.argsort(counts)[::-1][:h]
+    assert (remap[top] < h).all()
+    assert freq.hot_mass(counts, remap, h) > 0.5  # zipf head dominates
+
+
+def test_count_keys_samples_front(tmp_path):
+    p = tmp_path / "f-00000"
+    lines = [f"1\t0:{i % 7}:1.0\n" for i in range(1000)]
+    p.write_text("".join(lines))
+    from xflow_tpu.io.loader import make_parse_fn
+
+    parse_fn = make_parse_fn(1 << 12, True, 0, prefer_native=False)
+    counts = freq.count_keys([str(p)], parse_fn, 1 << 12, sample_bytes=10**9)
+    assert counts.sum() == 1000
+    assert (counts > 0).sum() == 7
+
+
+# -- end-to-end: hot == cold ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def zipfy_dataset(tmp_path_factory):
+    # wider vocab than the session toy set so hot(256) is a strict subset
+    from tests.gen_data import generate_dataset
+
+    root = tmp_path_factory.mktemp("zipfy")
+    return generate_dataset(
+        str(root),
+        num_train_shards=2,
+        lines_per_shard=300,
+        num_fields=10,
+        vocab_per_field=64,
+        seed=11,
+        scale=3.0,
+    )
+
+
+def _cfg(ds, **kw):
+    base = dict(
+        train_path=ds.train_prefix,
+        test_path=ds.test_prefix,
+        epochs=4,
+        batch_size=64,
+        table_size_log2=14,
+        max_nnz=16,
+        max_fields=12,
+        num_devices=1,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.mark.parametrize("model", ["lr", "fm"])
+def test_hot_training_matches_dma_training(zipfy_dataset, model, tmp_path):
+    cold = Trainer(_cfg(zipfy_dataset, model=model))
+    cold.train()
+    cold_out = tmp_path / "cold_pred.txt"
+    cold_res = cold.evaluate(pred_out=str(cold_out))
+
+    hot = Trainer(
+        _cfg(
+            zipfy_dataset,
+            model=model,
+            hot_size_log2=8,
+            hot_nnz=8,
+            freq_sample_mib=1,
+        )
+    )
+    assert hot.remap is not None
+    hot_out = tmp_path / "hot_pred.txt"
+    hot.train()
+    hot_res = hot.evaluate(pred_out=str(hot_out))
+
+    # same logical model: per-example predictions equal up to float
+    # summation order
+    cold_p = np.loadtxt(cold_out, usecols=1)
+    hot_p = np.loadtxt(hot_out, usecols=1)
+    np.testing.assert_allclose(hot_p, cold_p, rtol=2e-3, atol=2e-4)
+    assert abs(hot_res["auc"] - cold_res["auc"]) < 1e-3
+
+
+def test_hot_remap_persists_and_resumes(zipfy_dataset, tmp_path):
+    ckdir = tmp_path / "ck"
+    cfg = _cfg(
+        zipfy_dataset,
+        model="lr",
+        epochs=2,
+        hot_size_log2=8,
+        hot_nnz=8,
+        checkpoint_dir=str(ckdir),
+    )
+    t1 = Trainer(cfg)
+    t1.train()
+    r1 = t1.evaluate()
+    assert (ckdir / "remap.npy").exists()
+
+    # a fresh trainer must load the SAME remap (not recount) and restore
+    t2 = Trainer(cfg.replace(epochs=2))
+    np.testing.assert_array_equal(t1.remap, t2.remap)
+    assert t2.restore() is not None
+    r2 = t2.evaluate()
+    assert abs(r1["logloss"] - r2["logloss"]) < 1e-6
+
+
+def test_hot_multidevice_sharded_step(zipfy_dataset):
+    # full hot train step over the 8-virtual-device CPU mesh: validates
+    # that the MXU-path one-hot matmuls and the [0:H) dense add compile
+    # and psum correctly under pjit row-sharding
+    trainer = Trainer(
+        _cfg(
+            zipfy_dataset,
+            model="fm",
+            epochs=1,
+            num_devices=0,  # all 8 virtual devices
+            hot_size_log2=8,
+            hot_nnz=8,
+        )
+    )
+    trainer.train()
+    res = trainer.evaluate()
+    assert 0.0 < res["auc"] <= 1.0
+
+
+def test_prepare_batch_applies_remap_for_external_batches(zipfy_dataset):
+    # XFlow.predict_batch path: a user-built Batch carries raw hash-space
+    # keys; prepare_batch must remap + re-steer so predictions match the
+    # internal (loader-prepared) pipeline exactly
+    import jax
+
+    from xflow_tpu.io.loader import ShardLoader
+
+    cfg = _cfg(
+        zipfy_dataset, model="lr", epochs=2,
+        hot_size_log2=8, hot_nnz=8, freq_sample_mib=1,
+    )
+    tr = Trainer(cfg)
+    tr.train()
+    path = zipfy_dataset.test_prefix + "-00000"
+    raw_loader = ShardLoader(
+        path, batch_size=cfg.batch_size, max_nnz=cfg.max_nnz,
+        table_size=cfg.table_size, parse_fn=tr._parse_fn(),
+    )
+    int_loader = tr._loader(path)
+    n = 0
+    for (rb, _), (ib, _) in zip(
+        raw_loader.iter_batches(), int_loader.iter_batches()
+    ):
+        p_ext = jax.device_get(
+            tr.step.predict(tr.state, tr.step.put_batch(tr.prepare_batch(rb)))
+        )
+        p_int = jax.device_get(
+            tr.step.predict(tr.state, tr.step.put_batch(ib))
+        )
+        np.testing.assert_allclose(p_ext, p_int, rtol=1e-5, atol=1e-6)
+        n += 1
+    assert n > 0
+
+
+def test_hot_toggle_across_checkpoint_dir_is_rejected(zipfy_dataset, tmp_path):
+    # checkpointed table rows live in one key space; silently flipping
+    # the hot remap on or off across runs must be refused
+    ck_hot = tmp_path / "ck_hot"
+    cfg_hot = _cfg(
+        zipfy_dataset, model="lr", epochs=1,
+        hot_size_log2=8, hot_nnz=8, checkpoint_dir=str(ck_hot),
+    )
+    Trainer(cfg_hot).train()
+    with pytest.raises(ValueError, match="hot table"):
+        Trainer(cfg_hot.replace(hot_size_log2=0))
+
+    ck_cold = tmp_path / "ck_cold"
+    cfg_cold = _cfg(
+        zipfy_dataset, model="lr", epochs=1, checkpoint_dir=str(ck_cold)
+    )
+    Trainer(cfg_cold).train()
+    with pytest.raises(ValueError, match="WITHOUT"):
+        Trainer(cfg_cold.replace(hot_size_log2=8, hot_nnz=8))
+
+
+def test_hot_requires_dense_mode():
+    with pytest.raises(ValueError):
+        Config(hot_size_log2=8, update_mode="sparse")

@@ -29,10 +29,13 @@ class XFlow:
         return self.trainer.evaluate(pred_out=pred_out)
 
     def predict_batch(self, batch) -> np.ndarray:
-        """pctr for one padded Batch (see io/batch.py)."""
+        """pctr for one padded Batch built in the raw hash key space
+        (see io/batch.py).  When the model was trained with a hot table,
+        the trainer's frequency remap is applied here — the remap is
+        part of the model (io/freq.py)."""
         import jax
 
-        arrays = self.trainer.step.put_batch(batch)
+        arrays = self.trainer.step.put_batch(self.trainer.prepare_batch(batch))
         return np.asarray(
             jax.device_get(self.trainer.step.predict(self.trainer.state, arrays))
         )

@@ -129,6 +129,27 @@ class Config:
     # Both paths produce identical results (tests/test_update_modes.py).
     update_mode: str = "dense"
 
+    # -- hot table (frequency-partitioned head; docs/PERF.md "The win") --
+    # log2 of the hot-table row count H (0 = off).  CTR key distributions
+    # are zipfian; the top-H keys by frequency are permuted into table
+    # rows [0, H) (io/freq.py) and their gather/scatter runs as two-level
+    # one-hot MXU matmuls (ops/hot.py) instead of per-slice DMA —
+    # measured ~2x (f32) to ~4x (bf16) on the hot fraction on v5e.
+    # Requires update_mode="dense".
+    hot_size_log2: int = 0
+    # Static hot-key slots per sample (extra capacity on top of max_nnz;
+    # per-row hot overflow spills to the cold/DMA path, which is always
+    # correct).
+    hot_nnz: int = 24
+    # Bytes of training data sampled (from the front of the shard list,
+    # deterministically — identical on every host) to estimate key
+    # frequencies for the remap.
+    freq_sample_mib: int = 64
+    # Matmul input dtype for the hot path: "float32" = exact gather,
+    # order-only scatter difference; "bfloat16" = ~2x faster, rounds
+    # table/grad values to bf16 inside the hot path only.
+    hot_dtype: str = "float32"
+
     # -- precision --
     # Parameter/optimizer state dtype. float32 default; bf16 is not used
     # for FTRL state (z accumulates small increments).
@@ -143,10 +164,25 @@ class Config:
             raise ValueError(f"unknown update_mode {self.update_mode!r}")
         if not 10 <= self.table_size_log2 <= 30:
             raise ValueError("table_size_log2 must be in [10, 30]")
+        if self.hot_size_log2:
+            if self.update_mode != "dense":
+                raise ValueError("hot table requires update_mode='dense'")
+            if not 0 < self.hot_size_log2 < self.table_size_log2:
+                raise ValueError(
+                    "hot_size_log2 must be in (0, table_size_log2)"
+                )
+            if self.hot_nnz <= 0:
+                raise ValueError("hot_nnz must be > 0 when hot table is on")
+        if self.hot_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unknown hot_dtype {self.hot_dtype!r}")
 
     @property
     def table_size(self) -> int:
         return 1 << self.table_size_log2
+
+    @property
+    def hot_size(self) -> int:
+        return (1 << self.hot_size_log2) if self.hot_size_log2 else 0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)

@@ -26,6 +26,22 @@ class Batch:
     mask: np.ndarray  # float32 [B, K] — 1 for real feature entries
     labels: np.ndarray  # float32 [B] — binary labels
     weights: np.ndarray  # float32 [B] — 1 for real examples, 0 for padding
+    # Optional hot section (frequency-head keys < hot_size, served by the
+    # MXU path — ops/hot.py): [B, Kh] arrays, Kh = 0 when disabled.  The
+    # main arrays above then form the "cold" DMA-path section; a sample's
+    # logical feature list is the concatenation of both sections.
+    hot_keys: np.ndarray | None = None
+    hot_slots: np.ndarray | None = None
+    hot_vals: np.ndarray | None = None
+    hot_mask: np.ndarray | None = None
+
+    def __post_init__(self) -> None:
+        if self.hot_keys is None:
+            b = self.keys.shape[0]
+            self.hot_keys = np.zeros((b, 0), np.int32)
+            self.hot_slots = np.zeros((b, 0), np.int32)
+            self.hot_vals = np.zeros((b, 0), np.float32)
+            self.hot_mask = np.zeros((b, 0), np.float32)
 
     @property
     def batch_size(self) -> int:
@@ -34,6 +50,10 @@ class Batch:
     @property
     def max_nnz(self) -> int:
         return int(self.keys.shape[1])
+
+    @property
+    def hot_nnz(self) -> int:
+        return int(self.hot_keys.shape[1])
 
     def num_real(self) -> int:
         return int(self.weights.sum())
@@ -54,20 +74,101 @@ class ParsedBlock:
         return int(self.labels.shape[0])
 
 
+def split_hot(
+    keys: np.ndarray,
+    slots: np.ndarray,
+    vals: np.ndarray,
+    mask: np.ndarray,
+    hot_size: int,
+    hot_nnz: int,
+) -> dict[str, np.ndarray]:
+    """Steer padded [B, Ktot] feature entries into a hot section
+    ([B, hot_nnz], keys < hot_size) and a cold section ([B, Ktot -
+    hot_nnz], everything else).
+
+    Per row, the first ``hot_nnz`` hot entries (in original order) go to
+    the hot section; hot overflow spills into the cold section — which
+    is always correct, since the cold DMA path addresses the full table
+    including rows [0, hot_size).  Cold entries beyond the cold
+    capacity are truncated, the same semantics as the overall max_nnz
+    cap.  All O(B*Ktot) vectorized numpy; no per-row loops.
+    """
+    b, ktot = keys.shape
+    kh = hot_nnz
+    kc = ktot - kh
+    valid = mask > 0
+    is_hot = valid & (keys < hot_size)
+    hot_rank = np.cumsum(is_hot, axis=1) - 1
+    to_hot = is_hot & (hot_rank < kh)
+    eff_cold = valid & ~to_hot
+    cold_rank = np.cumsum(eff_cold, axis=1) - 1
+    to_cold = eff_cold & (cold_rank < kc)
+
+    def compact(arr, sel, rank, width, dtype):
+        """Left-compact arr[sel] into [b, width] rows; arr=None writes the
+        constant 1.0 (the mask) without materializing a ones array."""
+        out = np.zeros((b, width), dtype=dtype)
+        r, c = np.nonzero(sel)
+        out[r, rank[sel]] = 1.0 if arr is None else arr[r, c]
+        return out
+
+    return {
+        "hot_keys": compact(keys, to_hot, hot_rank, kh, np.int32),
+        "hot_slots": compact(slots, to_hot, hot_rank, kh, np.int32),
+        "hot_vals": compact(vals, to_hot, hot_rank, kh, np.float32),
+        "hot_mask": compact(None, to_hot, hot_rank, kh, np.float32),
+        "keys": compact(keys, to_cold, cold_rank, kc, np.int32),
+        "slots": compact(slots, to_cold, cold_rank, kc, np.int32),
+        "vals": compact(vals, to_cold, cold_rank, kc, np.float32),
+        "mask": compact(None, to_cold, cold_rank, kc, np.float32),
+    }
+
+
+def make_batch(
+    keys: np.ndarray,
+    slots: np.ndarray,
+    vals: np.ndarray,
+    mask: np.ndarray,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    hot_size: int = 0,
+    hot_nnz: int = 0,
+) -> Batch:
+    """Build a Batch from padded [B, Ktot] feature arrays, steering
+    entries into hot/cold sections when ``hot_size > 0`` (the single
+    construction point shared by pack_batch, prepare_batch, and the
+    bench/driver synthetic-batch builders)."""
+    if not hot_size:
+        return Batch(
+            keys=keys, slots=slots, vals=vals, mask=mask,
+            labels=labels, weights=weights,
+        )
+    return Batch(
+        labels=labels,
+        weights=weights,
+        **split_hot(keys, slots, vals, mask, hot_size, hot_nnz),
+    )
+
+
 def pack_batch(
     block: ParsedBlock,
     start: int,
     end: int,
     batch_size: int,
     max_nnz: int,
+    hot_size: int = 0,
+    hot_nnz: int = 0,
 ) -> Batch:
     """Pack samples [start, end) of a CSR block into one padded Batch.
 
     Rows with more than ``max_nnz`` features are truncated (the reference
-    has no per-sample feature cap; SURVEY §7 hard part (b)).
+    has no per-sample feature cap; SURVEY §7 hard part (b)).  With
+    ``hot_size > 0``, each row gets ``hot_nnz`` extra slots of hot-key
+    capacity and its entries are steered by ``split_hot``.
     """
     n = end - start
     assert 0 < n <= batch_size
+    ktot = max_nnz + (hot_nnz if hot_size else 0)
     labels = np.zeros(batch_size, dtype=np.float32)
     weights = np.zeros(batch_size, dtype=np.float32)
     labels[:n] = block.labels[start:end]
@@ -75,29 +176,28 @@ def pack_batch(
 
     starts = block.row_ptr[start:end]
     ends = block.row_ptr[start + 1 : end + 1]
-    counts = np.minimum(ends - starts, max_nnz)
+    counts = np.minimum(ends - starts, ktot)
     # vectorized ragged→padded gather: position j of row i reads CSR slot
     # starts[i]+j while j < counts[i]
-    j = np.arange(max_nnz, dtype=np.int64)[None, :]
+    j = np.arange(ktot, dtype=np.int64)[None, :]
     valid = j < counts[:, None]  # [n, K]
     src = np.where(valid, starts[:, None] + j, 0)
 
     def pad_gather(flat: np.ndarray, dtype) -> np.ndarray:
-        out = np.zeros((batch_size, max_nnz), dtype=dtype)
+        out = np.zeros((batch_size, ktot), dtype=dtype)
         if len(flat):
             out[:n] = np.where(valid, flat[src], 0)
         return out
 
-    return Batch(
-        keys=pad_gather(block.keys, np.int32),
-        slots=pad_gather(block.slots, np.int32),
-        vals=pad_gather(block.vals, np.float32),
-        mask=np.concatenate(
-            [
-                valid.astype(np.float32),
-                np.zeros((batch_size - n, max_nnz), np.float32),
-            ]
-        ),
-        labels=labels,
-        weights=weights,
+    keys = pad_gather(block.keys, np.int32)
+    slots = pad_gather(block.slots, np.int32)
+    vals = pad_gather(block.vals, np.float32)
+    mask = np.concatenate(
+        [
+            valid.astype(np.float32),
+            np.zeros((batch_size - n, ktot), np.float32),
+        ]
+    )
+    return make_batch(
+        keys, slots, vals, mask, labels, weights, hot_size, hot_nnz
     )

@@ -58,6 +58,9 @@ class ShardLoader:
         hash_mode: bool = True,
         hash_seed: int = 0,
         parse_fn: ParseFn | None = None,
+        remap=None,  # int32 [table_size] permutation (io/freq.py), or None
+        hot_size: int = 0,
+        hot_nnz: int = 0,
     ):
         self.path = path
         self.batch_size = batch_size
@@ -69,18 +72,27 @@ class ShardLoader:
                 data, table_size, hash_mode, hash_seed
             )
         self.parse_fn = parse_fn
+        self.remap = remap
+        self.hot_size = hot_size
+        self.hot_nnz = hot_nnz
 
     def _block_to_batches(
         self, raw: bytes, offset: int, next_offset: int
     ) -> list[tuple[Batch, int]]:
         block = self.parse_fn(raw)
+        if self.remap is not None and len(block.keys):
+            # frequency remap: pure row-placement permutation (io/freq.py)
+            block.keys = self.remap[block.keys]
         out = []
         n = block.num_samples
         for start in range(0, n, self.batch_size):
             end = min(start + self.batch_size, n)
             out.append(
                 (
-                    pack_batch(block, start, end, self.batch_size, self.max_nnz),
+                    pack_batch(
+                        block, start, end, self.batch_size, self.max_nnz,
+                        self.hot_size, self.hot_nnz,
+                    ),
                     offset if end < n else next_offset,
                 )
             )

@@ -1,0 +1,157 @@
+"""Two-level one-hot MXU gather/scatter for the frequency-hot table head.
+
+XLA TPU gather/scatter cost is per *slice* (~8-14 ns of DMA descriptor
+issue each, independent of slice width — docs/PERF.md), so a step over
+M = B*nnz feature occurrences pays ~18 ns/occurrence of round-trip DMA
+no matter what.  CTR key distributions are zipfian: after the frequency
+remap (io/freq.py) the head of the distribution lives in table rows
+[0, H).  For those occurrences we replace per-slice DMA with two-level
+one-hot matmuls that ride the MXU:
+
+    key = hi * h2 + lo            (H = h1 * h2)
+    gather:  rows = ((onehot_hi @ W) . reshape  *  onehot_lo) sum over lo
+    scatter: W'   = onehot_hi^T @ (g * onehot_lo)
+
+Traffic is M*(h1 + h2*D) one-hot elements instead of M DMA descriptors;
+measured ~2x (f32, exact) to ~4x (bf16) over the DMA path for the hot
+fraction on v5e (scripts/probe_hot2.py; docs/PERF.md "The win").
+
+One-hot intermediates are built in chunks under ``lax.scan`` so the
+[C, h2*D] temporaries stay within a few MiB regardless of M or D.
+
+Numerics: with ``dtype=float32`` the gather is *exact* (each one-hot row
+selects a single W element; no accumulation), and the scatter differs
+from ``.at[].add`` only in summation order.  ``bfloat16`` trades W/g
+mantissa for ~2x more speed; the default is float32.
+
+Sentinel behavior: any key outside [0, H) produces an all-zero onehot_hi
+row, so out-of-range/padding keys gather a zero row and scatter nothing
+— mirroring the drop/clip semantics of ops/sparse.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def hot_factors(hot_size: int) -> tuple[int, int]:
+    """Split H = h1 * h2 with h1 >= h2, both powers of two.
+
+    h1 is the matmul contraction width for level 1 (oh_hi @ W) and h2
+    the lane-select width for level 2; near-square minimizes
+    h1 + h2*D traffic per occurrence.
+    """
+    log2 = hot_size.bit_length() - 1
+    if hot_size != 1 << log2:
+        raise ValueError(f"hot_size must be a power of two, got {hot_size}")
+    h1 = 1 << ((log2 + 1) // 2)
+    return h1, hot_size // h1
+
+
+def _chunk(h1: int, h2: int, d: int, m: int) -> int:
+    """Rows per scan chunk: bound the [C, max(h1, h2*D)] temporaries to
+    ~2^21 f32 elements (8 MiB), and never pad a small M (e.g. an online-
+    inference batch) up to a huge chunk."""
+    width = max(h1, h2 * d)
+    c = max(256, (1 << 21) // width)
+    c = 1 << (c.bit_length() - 1)  # round down to a power of two
+    m_pow2 = 1 << max(m - 1, 1).bit_length()  # round M up to a power of two
+    return min(c, m_pow2)
+
+
+def _pad_to(x: jax.Array, m_pad: int, fill) -> jax.Array:
+    m = x.shape[0]
+    if m_pad == m:
+        return x
+    pad_shape = (m_pad - m,) + x.shape[1:]
+    return jnp.concatenate([x, jnp.full(pad_shape, fill, x.dtype)])
+
+
+def hot_gather(
+    w_hot: jax.Array,
+    keys: jax.Array,
+    *,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Gather rows of the hot table via two-level one-hot matmuls.
+
+    Args:
+      w_hot: [H, D] hot-table rows (H a power of two).
+      keys: int32 [M]; entries outside [0, H) yield zero rows.
+      dtype: matmul input dtype (float32 exact, bfloat16 fast).
+
+    Returns: [M, D] gathered rows, float32.
+    """
+    h, d = w_hot.shape
+    h1, h2 = hot_factors(h)
+    m = keys.shape[0]
+    c = _chunk(h1, h2, d, m)
+    m_pad = ((m + c - 1) // c) * c
+    kp = _pad_to(keys, m_pad, h)  # sentinel: all-zero one-hot
+    wr = w_hot.reshape(h1, h2 * d).astype(dtype)
+    ar1 = jnp.arange(h1, dtype=kp.dtype)
+    ar2 = jnp.arange(h2, dtype=kp.dtype)
+
+    def body(_, k):
+        hi = k // h2
+        lo = k % h2
+        oh_hi = (hi[:, None] == ar1[None, :]).astype(dtype)  # [C, h1]
+        rows = jnp.dot(
+            oh_hi, wr, preferred_element_type=jnp.float32
+        ).reshape(c, h2, d)
+        oh_lo = (lo[:, None] == ar2[None, :]).astype(jnp.float32)  # [C, h2]
+        return None, jnp.einsum("chd,ch->cd", rows, oh_lo)
+
+    _, out = jax.lax.scan(body, None, kp.reshape(-1, c))
+    return out.reshape(m_pad, d)[:m]
+
+
+def hot_scatter(
+    keys: jax.Array,
+    grads: jax.Array,
+    hot_size: int,
+    *,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Sum per-occurrence gradients into a dense [H, D] buffer via
+    two-level one-hot matmuls (the MXU replacement for
+    ``zeros([H, D]).at[keys].add(grads)``).
+
+    Args:
+      keys: int32 [M]; entries outside [0, H) are dropped.
+      grads: float [M, D].
+      hot_size: H (power of two).
+      dtype: matmul input dtype for the [h1, M]@[M, h2*D] contraction.
+
+    Returns: [H, D] float32 gradient sums.
+    """
+    m, d = grads.shape
+    h1, h2 = hot_factors(hot_size)
+    c = _chunk(h1, h2, d, m)
+    m_pad = ((m + c - 1) // c) * c
+    kp = _pad_to(keys, m_pad, hot_size)
+    gp = _pad_to(grads, m_pad, 0)
+    ar1 = jnp.arange(h1, dtype=kp.dtype)
+    ar2 = jnp.arange(h2, dtype=kp.dtype)
+
+    def body(acc, xs):
+        k, g = xs
+        hi = k // h2
+        lo = k % h2
+        oh_hi = (hi[:, None] == ar1[None, :]).astype(dtype)  # [C, h1]
+        oh_lo = (lo[:, None] == ar2[None, :]).astype(g.dtype)  # [C, h2]
+        glo = (g[:, :, None] * oh_lo[:, None, :]).reshape(c, d * h2)
+        # accumulate in f32 regardless of input dtype
+        acc = acc + jnp.dot(
+            oh_hi.T, glo.astype(dtype), preferred_element_type=jnp.float32
+        )
+        return acc, None
+
+    acc0 = jnp.zeros((h1, d * h2), jnp.float32)
+    acc, _ = jax.lax.scan(
+        body, acc0, (kp.reshape(-1, c), gp.reshape(-1, c, d))
+    )
+    # glo flattened [C, d, h2] -> acc is [h1, (d, h2)]; reorder to
+    # [h1, h2, d] so row hi*h2+lo lands at table row `key`.
+    return acc.reshape(h1, d, h2).transpose(0, 2, 1).reshape(h1 * h2, d)

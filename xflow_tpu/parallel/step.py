@@ -71,7 +71,7 @@ def init_state(model: Model, optimizer: Optimizer, cfg: Config, mesh) -> State:
 
 
 def batch_to_arrays(batch: Batch) -> BatchArrays:
-    return {
+    out = {
         "keys": jnp.asarray(batch.keys),
         "slots": jnp.asarray(batch.slots),
         "vals": jnp.asarray(batch.vals),
@@ -79,6 +79,12 @@ def batch_to_arrays(batch: Batch) -> BatchArrays:
         "labels": jnp.asarray(batch.labels),
         "weights": jnp.asarray(batch.weights),
     }
+    if batch.hot_nnz:
+        out["hot_keys"] = jnp.asarray(batch.hot_keys)
+        out["hot_slots"] = jnp.asarray(batch.hot_slots)
+        out["hot_vals"] = jnp.asarray(batch.hot_vals)
+        out["hot_mask"] = jnp.asarray(batch.hot_mask)
+    return out
 
 
 class TrainStep:
@@ -91,6 +97,9 @@ class TrainStep:
         self.cfg = cfg
         self.mesh = mesh
         self._bsharding = batch_sharding(mesh)
+        self._hot_dtype = (
+            jnp.bfloat16 if cfg.hot_dtype == "bfloat16" else jnp.float32
+        )
         self.train = jax.jit(self._train_impl, donate_argnums=0)
         self.predict = jax.jit(self._predict_impl)
 
@@ -118,7 +127,48 @@ class TrainStep:
     ) -> dict[str, jax.Array]:
         # Forward gather uses raw keys; padding entries read row 0 but are
         # masked out of every reduction by batch["mask"].
-        return {name: t["param"][batch["keys"]] for name, t in tables.items()}
+        cold = {name: t["param"][batch["keys"]] for name, t in tables.items()}
+        if "hot_keys" not in batch:
+            return cold
+        # Hot section: two-level one-hot MXU gather over table rows
+        # [0, H) (ops/hot.py); rows for the two sections are concatenated
+        # feature-axis-first so the model sees one [B, Kh+Kc, D] block
+        # aligned with _model_view's concatenated slots/vals/mask.
+        from xflow_tpu.ops.hot import hot_gather
+
+        h = self.cfg.hot_size
+        b, kh = batch["hot_keys"].shape
+        out = {}
+        for name, t in tables.items():
+            d = t["param"].shape[-1]
+            hot = hot_gather(
+                t["param"][:h],
+                batch["hot_keys"].reshape(-1),
+                dtype=self._hot_dtype,
+            ).reshape(b, kh, d)
+            out[name] = jnp.concatenate([hot, cold[name]], axis=1)
+        return out
+
+    def _model_view(self, batch: BatchArrays) -> BatchArrays:
+        """Batch as the model sees it: hot + cold sections concatenated
+        along the feature axis (models are permutation-invariant over a
+        sample's features — they reduce over the feature axis)."""
+        if "hot_keys" not in batch:
+            return batch
+        view = dict(batch)
+        view["keys"] = jnp.concatenate(
+            [batch["hot_keys"], batch["keys"]], axis=1
+        )
+        view["slots"] = jnp.concatenate(
+            [batch["hot_slots"], batch["slots"]], axis=1
+        )
+        view["vals"] = jnp.concatenate(
+            [batch["hot_vals"], batch["vals"]], axis=1
+        )
+        view["mask"] = jnp.concatenate(
+            [batch["hot_mask"], batch["mask"]], axis=1
+        )
+        return view
 
     # -- compiled bodies ---------------------------------------------------
 
@@ -136,6 +186,8 @@ class TrainStep:
         tables = state["tables"]
         dense = state["dense"]
         rows = self._gather_model_rows(tables, batch)
+        mbatch = self._model_view(batch)
+        kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
         num_real = jnp.maximum(jnp.sum(batch["weights"]), 1.0)
         new_dense = dense
         if getattr(self.model, "autodiff", False):
@@ -143,10 +195,10 @@ class TrainStep:
             # stable BCE-with-logits; d/dlogit = sigmoid(logit) - y, the
             # same residual semantics as the explicit path.
             def loss_fn(rows_, dense_):
-                logit_ = self.model.logit(rows_, batch, dense_)
-                nll = jax.nn.softplus(logit_) - batch["labels"] * logit_
+                logit_ = self.model.logit(rows_, mbatch, dense_)
+                nll = jax.nn.softplus(logit_) - mbatch["labels"] * logit_
                 return (
-                    jnp.sum(nll * batch["weights"]) / num_real,
+                    jnp.sum(nll * mbatch["weights"]) / num_real,
                     logit_,
                 )
 
@@ -160,14 +212,14 @@ class TrainStep:
                     lambda p, g: p - cfg.sgd_lr * g, dense, grad_dense
                 )
         else:
-            logit = self.model.logit(rows, batch)
+            logit = self.model.logit(rows, mbatch)
             pctr = sigmoid_ref(logit)
             # Residual "loss" exactly as the reference names it
             # (lr_worker.cc:121-143): sigma(wx) - y, zeroed for pad
             # examples, pre-divided by batch size for the mean-gradient
             # semantics.
-            residual = (pctr - batch["labels"]) * batch["weights"] / num_real
-            grad_logit = self.model.grad_logit(rows, batch)
+            residual = (pctr - mbatch["labels"]) * mbatch["weights"] / num_real
+            grad_logit = self.model.grad_logit(rows, mbatch)
             occ_grads = {
                 name: g * residual[:, None, None]
                 for name, g in grad_logit.items()
@@ -177,11 +229,25 @@ class TrainStep:
         keys_eff = jnp.where(
             batch["mask"] > 0, batch["keys"], sentinel
         ).reshape(-1)
+        if kh:
+            from xflow_tpu.ops.hot import hot_scatter
+
+            hot_keys_eff = jnp.where(
+                batch["hot_mask"] > 0,
+                batch["hot_keys"],
+                jnp.int32(cfg.hot_size),
+            ).reshape(-1)
 
         new_tables = {}
         for name, table in tables.items():
             d = table["param"].shape[-1]
-            flat_g = occ_grads[name].reshape(-1, d)
+            occ = occ_grads[name]
+            if kh:
+                # hot section grads ride the MXU into a dense [H, D]
+                # buffer; cold grads keep the DMA scatter path.
+                hot_g = occ[:, :kh].reshape(-1, d)
+                occ = occ[:, kh:]
+            flat_g = occ.reshape(-1, d)
             if cfg.update_mode == "dense":
                 # Scatter-add consolidates duplicate keys; the optimizer
                 # recurrence then runs elementwise over the full table —
@@ -190,6 +256,12 @@ class TrainStep:
                 gbuf = jnp.zeros_like(table["param"]).at[keys_eff].add(
                     flat_g, mode="drop"
                 )
+                if kh:
+                    ghot = hot_scatter(
+                        hot_keys_eff, hot_g, cfg.hot_size,
+                        dtype=self._hot_dtype,
+                    )
+                    gbuf = gbuf.at[: cfg.hot_size].add(ghot)
                 new_tables[name] = self.optimizer.update_rows(table, gbuf)
             else:
                 ukeys, gsum = consolidate(keys_eff, flat_g, cfg.table_size)
@@ -216,4 +288,6 @@ class TrainStep:
     def _predict_impl(self, state: State, batch: BatchArrays) -> jax.Array:
         """pctr per example (reference calculate_pctr, lr_worker.cc:46-61)."""
         rows = self._gather_model_rows(state["tables"], batch)
-        return sigmoid_ref(self._logit(rows, batch, state["dense"]))
+        return sigmoid_ref(
+            self._logit(rows, self._model_view(batch), state["dense"])
+        )

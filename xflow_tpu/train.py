@@ -59,6 +59,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int)
     p.add_argument("--num-devices", type=int, dest="num_devices")
     p.add_argument("--no-hash", action="store_true", help="numeric fids, keep values")
+    p.add_argument(
+        "--hot-size-log2", type=int, dest="hot_size_log2",
+        help="log2 rows of the frequency-hot MXU table (0 = off)",
+    )
+    p.add_argument("--hot-nnz", type=int, dest="hot_nnz")
+    p.add_argument(
+        "--freq-sample-mib", type=int, dest="freq_sample_mib",
+        help="MiB of training data sampled to build the hot-key remap",
+    )
+    p.add_argument(
+        "--hot-dtype", choices=["float32", "bfloat16"], dest="hot_dtype"
+    )
     p.add_argument("--pred-out", dest="pred_out")
     p.add_argument("--metrics-out", dest="metrics_out")
     p.add_argument("--profile-dir", dest="profile_dir")

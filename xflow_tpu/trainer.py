@@ -90,8 +90,95 @@ class Trainer:
         # Multi-host: each process reads its own shard subset.
         self.host = jax.process_index()
         self.num_hosts = jax.process_count()
+        # Hot-table frequency remap (io/freq.py): loaded from the
+        # checkpoint dir when present, else measured from a deterministic
+        # sample of the training data (identical on every host).
+        self.remap = None
+        if cfg.hot_size_log2:
+            self._init_remap()
+        else:
+            # guard the reverse of _init_remap's table_size check: a
+            # checkpoint trained WITH a hot table stores rows in the
+            # permuted space; resuming it hot-off would read wrong rows
+            path = self._remap_path()
+            if path is not None:
+                import os
+
+                if os.path.exists(path):
+                    raise ValueError(
+                        f"{path} exists: this checkpoint_dir was trained "
+                        "with a hot table; set hot_size_log2 to match "
+                        "(or use a fresh checkpoint_dir)"
+                    )
+
+    def _remap_path(self) -> str | None:
+        if not self.cfg.checkpoint_dir:
+            return None
+        import os
+
+        return os.path.join(self.cfg.checkpoint_dir, "remap.npy")
+
+    def _init_remap(self) -> None:
+        cfg = self.cfg
+        from xflow_tpu.io import freq
+
+        path = self._remap_path()
+        if path is not None:
+            existing = freq.load_remap(path)
+            if existing is not None:
+                if len(existing) != cfg.table_size:
+                    raise ValueError(
+                        f"saved remap at {path} has {len(existing)} rows "
+                        f"but table_size is {cfg.table_size} — "
+                        "table_size_log2 changed between runs?"
+                    )
+                self.remap = existing
+                return
+        if path is not None and latest_checkpoint(cfg.checkpoint_dir):
+            raise ValueError(
+                "hot table enabled but this checkpoint_dir holds a "
+                "checkpoint trained WITHOUT one (no remap.npy): the table "
+                "rows live in the unpermuted key space — set "
+                "hot_size_log2=0 to resume it, or use a fresh "
+                "checkpoint_dir"
+            )
+        if not cfg.train_path:
+            raise ValueError(
+                "hot table enabled but no train_path to sample key "
+                "frequencies from and no saved remap in checkpoint_dir"
+            )
+        # Global shard list (not this host's subset) so every host
+        # computes the identical permutation without communication.
+        shards = find_shards(cfg.train_path)
+        counts = freq.count_keys(
+            shards,
+            self._parse_fn(),
+            cfg.table_size,
+            cfg.freq_sample_mib << 20,
+            cfg.block_mib << 20,
+        )
+        self.remap = freq.build_remap(counts, cfg.hot_size)
+        mass = freq.hot_mass(counts, self.remap, cfg.hot_size)
+        self._log(
+            f"hot remap: {cfg.hot_size} rows capture {mass:.1%} of "
+            f"sampled feature occurrences"
+        )
+        if path is not None and self.host == 0:
+            import os
+
+            os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+            freq.save_remap(path, self.remap)
 
     # -- data --------------------------------------------------------------
+
+    def _parse_fn(self):
+        cfg = self.cfg
+        return make_parse_fn(
+            cfg.table_size,
+            cfg.hash_mode,
+            cfg.seed,
+            prefer_native=cfg.native_parser,
+        )
 
     def _loader(self, path: str) -> ShardLoader:
         cfg = self.cfg
@@ -103,12 +190,10 @@ class Trainer:
             block_mib=cfg.block_mib,
             hash_mode=cfg.hash_mode,
             hash_seed=cfg.seed,
-            parse_fn=make_parse_fn(
-                cfg.table_size,
-                cfg.hash_mode,
-                cfg.seed,
-                prefer_native=cfg.native_parser,
-            ),
+            parse_fn=self._parse_fn(),
+            remap=self.remap,
+            hot_size=cfg.hot_size,
+            hot_nnz=cfg.hot_nnz,
         )
 
     def _parse_workers(self) -> int:
@@ -142,6 +227,35 @@ class Trainer:
             )
             for batch, resume in it:
                 yield batch, si, resume
+
+    def prepare_batch(self, batch: Batch) -> Batch:
+        """Bring an externally built Batch (raw hash-space keys, see
+        io/batch.py) into this model's key space: apply the hot remap
+        and re-steer the hot/cold sections.  Loader-produced batches are
+        already prepared; this is for user-supplied batches
+        (api.XFlow.predict_batch)."""
+        if self.remap is None:
+            return batch
+        from xflow_tpu.io.batch import make_batch
+
+        # merge any existing hot section back, remap, then re-steer (a
+        # remapped key may cross the hot/cold boundary in either direction);
+        # pad by hot_nnz columns so the post-split cold capacity equals the
+        # full incoming width — even if every incoming entry lands cold,
+        # nothing is truncated on re-steer
+        kh = self.cfg.hot_nnz
+        b = batch.batch_size
+        pad_i = np.zeros((b, kh), np.int32)
+        pad_f = np.zeros((b, kh), np.float32)
+        keys = np.concatenate([batch.hot_keys, batch.keys, pad_i], axis=1)
+        slots = np.concatenate([batch.hot_slots, batch.slots, pad_i], axis=1)
+        vals = np.concatenate([batch.hot_vals, batch.vals, pad_f], axis=1)
+        mask = np.concatenate([batch.hot_mask, batch.mask, pad_f], axis=1)
+        keys = np.where(mask > 0, self.remap[keys], 0).astype(np.int32)
+        return make_batch(
+            keys, slots, vals, mask, batch.labels, batch.weights,
+            self.cfg.hot_size, self.cfg.hot_nnz,
+        )
 
     # -- training ----------------------------------------------------------
 
