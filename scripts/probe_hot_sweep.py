@@ -1,0 +1,48 @@
+"""Sweep hot-table size and dtype on the flagship bench workload.
+
+Run: python scripts/probe_hot_sweep.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+
+from bench import build, make_batches, run
+from xflow_tpu.config import Config
+
+
+def main():
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    base = dict(
+        model="lr",
+        optimizer="ftrl",
+        table_size_log2=24,
+        batch_size=131072,
+        max_nnz=32,
+        hot_nnz=16,
+        num_devices=1,
+    )
+    configs = [("off", Config(**{**base, "max_nnz": 40, "hot_nnz": 24}))]
+    for log2, dt in (
+        (12, "float32"),
+        (12, "bfloat16"),
+        (14, "float32"),
+        (14, "bfloat16"),
+    ):
+        configs.append(
+            (
+                f"H=2^{log2} {dt}",
+                Config(**{**base, "hot_size_log2": log2, "hot_dtype": dt}),
+            )
+        )
+    for name, cfg in configs:
+        step, state = build(accel, cfg)
+        batches = make_batches(cfg, 2)
+        _, eps = run(step, state, batches, iters=10, warmup=2)
+        print(f"{name:18s} {eps/1e6:6.3f} M ex/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Python side of the C ABI (native/src/c_api.cc).
+
+The reference shipped a (disabled) C API wrapping LRWorker —
+``XFCreate(handle, train, test)`` / ``XFStartTrain(handle)``
+(c_api.h:26-41, build commented out at CMakeLists.txt:28, includes
+stale) — signalling an intended embed-as-a-library surface.  Here that
+surface is real: ``libxflow_tpu.so`` embeds CPython and drives these
+functions; C/C++ programs get create/train/evaluate/predict without a
+Python process.
+
+Kept deliberately tiny: the C side only imports this module and calls
+these three functions, so the ABI never needs to know about Config or
+Trainer internals.
+"""
+
+from __future__ import annotations
+
+import json
+
+from xflow_tpu.api import XFlow
+
+
+def create(train_path: str, test_path: str, config_json: str) -> XFlow:
+    overrides = json.loads(config_json) if config_json else {}
+    return XFlow(train_path, test_path, **overrides)
+
+
+def train(xf: XFlow) -> int:
+    xf.train()
+    return 0
+
+
+def evaluate(xf: XFlow) -> tuple[float, float]:
+    res = xf.evaluate()
+    return float(res["logloss"]), float(res["auc"])

@@ -21,20 +21,54 @@ LIB = _DIR / "libxflow_io.so"
 CXXFLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared", "-Wall"]
 
 
-def build_if_needed(force: bool = False) -> Path:
-    if not force and LIB.exists() and LIB.stat().st_mtime >= SRC.stat().st_mtime:
-        return LIB
+def _compile(
+    src: Path, out: Path, extra_flags: list[str], link_flags: list[str] = ()
+) -> Path:
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(_DIR))
     os.close(fd)
     try:
         subprocess.run(
-            ["g++", *CXXFLAGS, "-o", tmp, str(SRC)],
+            # link libraries must follow the source file
+            ["g++", *CXXFLAGS, *extra_flags, "-o", tmp, str(src), *link_flags],
             check=True,
             capture_output=True,
             text=True,
         )
-        os.replace(tmp, LIB)  # atomic: concurrent builders race benignly
+        os.replace(tmp, out)  # atomic: concurrent builders race benignly
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
-    return LIB
+    return out
+
+
+def build_if_needed(force: bool = False) -> Path:
+    if not force and LIB.exists() and LIB.stat().st_mtime >= SRC.stat().st_mtime:
+        return LIB
+    return _compile(SRC, LIB, [])
+
+
+CAPI_SRC = _DIR / "src" / "c_api.cc"
+CAPI_LIB = _DIR / "libxflow_tpu.so"
+
+
+def build_capi(force: bool = False) -> Path:
+    """Build the embed-CPython C ABI library (include/xflow_tpu.h).
+    Needs python3-config (python headers); raises on failure — callers
+    of the C API opted into the native toolchain."""
+    if (
+        not force
+        and CAPI_LIB.exists()
+        and CAPI_LIB.stat().st_mtime >= CAPI_SRC.stat().st_mtime
+    ):
+        return CAPI_LIB
+
+    def cfg(*args: str) -> list[str]:
+        out = subprocess.run(
+            ["python3-config", *args], check=True, capture_output=True,
+            text=True,
+        )
+        return out.stdout.split()
+
+    return _compile(
+        CAPI_SRC, CAPI_LIB, cfg("--includes"), cfg("--ldflags", "--embed")
+    )

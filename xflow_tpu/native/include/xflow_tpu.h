@@ -1,0 +1,37 @@
+/* C ABI for embedding xflow-tpu (see native/src/c_api.cc).
+ *
+ * The live counterpart of the reference's intended-but-dead C API
+ * (c_api.h:26-41).  Link against libxflow_tpu.so; ensure the xflow_tpu
+ * package is importable by the embedded interpreter (PYTHONPATH).
+ *
+ * Minimal use:
+ *   XFHandle h = XFCreate("data/train", "data/test",
+ *                         "{\"model\": \"lr\", \"epochs\": 5}");
+ *   if (!h) { fprintf(stderr, "%s\n", XFLastError()); return 1; }
+ *   XFStartTrain(h);
+ *   double ll, auc;
+ *   XFEvaluate(h, &ll, &auc);
+ *   XFDestroy(h);
+ */
+#ifndef XFLOW_TPU_C_API_H_
+#define XFLOW_TPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* XFHandle;
+
+/* config_json: JSON object of xflow_tpu.config.Config fields, or NULL. */
+XFHandle XFCreate(const char* train_path, const char* test_path,
+                  const char* config_json);
+int XFStartTrain(XFHandle h);
+int XFEvaluate(XFHandle h, double* logloss, double* auc);
+void XFDestroy(XFHandle h);
+const char* XFLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* XFLOW_TPU_C_API_H_ */

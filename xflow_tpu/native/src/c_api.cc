@@ -1,0 +1,138 @@
+// C ABI for embedding xflow-tpu in C/C++ programs.
+//
+// The reference's src/c_api/{c_api.h,c_api.cc} declared
+// XFCreate(handle, train, test) / XFStartTrain(handle) around LRWorker
+// but was dead code (build commented out, stale includes).  This is the
+// live TPU-native equivalent: the library embeds a CPython interpreter
+// and drives xflow_tpu.capi_impl, so the whole framework (any model,
+// any optimizer, hot table, checkpointing) is reachable from C with
+// four functions.  Configuration beyond the two paths is passed as a
+// JSON object string matching xflow_tpu.config.Config fields.
+//
+// Thread-model: all calls must come from one thread (the embedded
+// interpreter is initialized lazily on first XFCreate).  Errors return
+// NULL/-1; XFLastError() returns a static description of the most
+// recent failure.
+
+#include <Python.h>
+
+#include <string>
+
+namespace {
+
+std::string g_last_error;
+
+void capture_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      g_last_error = c != nullptr ? c : "<unprintable python error>";
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "<unknown python error>";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+bool ensure_python() {
+  if (Py_IsInitialized() != 0) return true;
+  Py_InitializeEx(0);  // no signal handlers: the host app owns them
+  return Py_IsInitialized() != 0;
+}
+
+// Call xflow_tpu.capi_impl.<fn>(args...); returns a new reference or
+// nullptr with g_last_error set.
+PyObject* call_impl(const char* fn, PyObject* args) {
+  PyObject* mod = PyImport_ImportModule("xflow_tpu.capi_impl");
+  if (mod == nullptr) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  if (out == nullptr) capture_py_error();
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* XFHandle;
+
+const char* XFLastError() { return g_last_error.c_str(); }
+
+// config_json: optional JSON object of xflow_tpu.config.Config fields
+// ({"model": "fm", "epochs": 5, ...}); NULL or "" for defaults.
+XFHandle XFCreate(const char* train_path, const char* test_path,
+                  const char* config_json) {
+  if (!ensure_python()) {
+    g_last_error = "failed to initialize embedded python";
+    return nullptr;
+  }
+  PyObject* args = Py_BuildValue(
+      "(sss)", train_path != nullptr ? train_path : "",
+      test_path != nullptr ? test_path : "",
+      config_json != nullptr ? config_json : "");
+  if (args == nullptr) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* xf = call_impl("create", args);
+  Py_DECREF(args);
+  return static_cast<XFHandle>(xf);  // new reference owned by the handle
+}
+
+int XFStartTrain(XFHandle h) {
+  if (h == nullptr) return -1;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
+  if (args == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* out = call_impl("train", args);
+  Py_DECREF(args);
+  if (out == nullptr) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+int XFEvaluate(XFHandle h, double* logloss, double* auc) {
+  if (h == nullptr) return -1;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
+  if (args == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* out = call_impl("evaluate", args);
+  Py_DECREF(args);
+  if (out == nullptr) return -1;
+  double ll = 0.0, a = 0.0;
+  if (PyArg_ParseTuple(out, "dd", &ll, &a) == 0) {
+    capture_py_error();
+    Py_DECREF(out);
+    return -1;
+  }
+  Py_DECREF(out);
+  if (logloss != nullptr) *logloss = ll;
+  if (auc != nullptr) *auc = a;
+  return 0;
+}
+
+void XFDestroy(XFHandle h) {
+  if (h != nullptr) Py_DECREF(static_cast<PyObject*>(h));
+}
+
+}  // extern "C"

@@ -82,6 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--resume", action="store_true", help="resume from latest checkpoint")
     p.add_argument(
+        "--platform",
+        choices=["tpu", "cpu", "gpu"],
+        help="force the JAX backend (overrides plugin auto-selection; "
+        "needed e.g. to run the distributed path on CPU processes)",
+    )
+    p.add_argument(
         "--coordinator",
         help="host:port of process 0 for multi-host (jax.distributed); "
         "also requires --process-id and --num-processes",
@@ -111,6 +117,12 @@ def config_from_args(args: argparse.Namespace) -> Config:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.platform:
+        # must precede any backend initialization (the env var alone can
+        # be overridden by platform plugins registered at site import)
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     if args.coordinator:
         import jax
 
@@ -128,7 +140,13 @@ def main(argv: list[str] | None = None) -> int:
         cursor = trainer.restore()
         if cursor:
             print(f"resumed at {cursor}", file=sys.stderr)
-    trainer.train()
+    history = trainer.train()
+    if history and history[-1].get("preempted"):
+        print(
+            "preempted: checkpoint saved, resume with --resume",
+            file=sys.stderr,
+        )
+        return 0
     if cfg.test_path and not args.skip_eval:
         trainer.evaluate()
     return 0

@@ -86,6 +86,8 @@ class Trainer:
 
             self.metrics_logger = MetricsLogger(cfg.metrics_out)
         self._profiled = False
+        self._preempted = False
+        self._preempt_agreed = False
         self._global_steps = 0  # across epochs; drives the profile trigger
         # Multi-host: each process reads its own shard subset.
         self.host = jax.process_index()
@@ -228,6 +230,81 @@ class Trainer:
             for batch, resume in it:
                 yield batch, si, resume
 
+    def _empty_batch(self) -> Batch:
+        """All-padding batch (weights/mask 0): a no-op training step with
+        the same static shapes the loader produces."""
+        from xflow_tpu.io.batch import make_batch
+
+        cfg = self.cfg
+        b = cfg.batch_size
+        k = cfg.max_nnz + (cfg.hot_nnz if cfg.hot_size else 0)
+        z_i = np.zeros((b, k), np.int32)
+        z_f = np.zeros((b, k), np.float32)
+        return make_batch(
+            z_i, z_i, z_f, z_f,
+            np.zeros(b, np.float32), np.zeros(b, np.float32),
+            cfg.hot_size, cfg.hot_nnz,
+        )
+
+    def _synced_batches(
+        self,
+        it: Iterator[tuple[Batch, int, int]],
+        vote_preempt: bool = False,
+    ) -> Iterator[tuple[Batch, int, int]]:
+        """SPMD step-count agreement across hosts.
+
+        Every pjit'd step is collective over the global mesh, so all
+        processes MUST call it the same number of times — but hosts own
+        different shard subsets (``i % num_hosts``) whose sizes differ
+        when shards don't divide evenly (the reference had no such
+        constraint: its workers were fully async, SURVEY §2 parallelism
+        table).  A host whose local data ran out keeps feeding
+        zero-weight padding batches (no-op updates: FTRL/SGD are
+        idempotent at g=0) until every host votes done; the vote rides a
+        1-int allgather per step.
+
+        With ``vote_preempt`` the same allgather carries this host's
+        preemption flag (vote 2): ANY host's SIGTERM stops every host at
+        the same step, and the caller sees ``self._preempt_agreed`` —
+        required because the subsequent checkpoint save is itself
+        collective.  Single-host runs skip the voting entirely (the
+        caller checks ``self._preempted`` directly).
+        """
+        if self.num_hosts == 1:
+            yield from it
+            return
+        from jax.experimental import multihost_utils
+
+        local_done = False
+        last = (0, 0)
+        pad: Batch | None = None
+        while True:
+            item = None
+            if not local_done:
+                try:
+                    item = next(it)
+                except StopIteration:
+                    local_done = True
+            mine = 2 if (vote_preempt and self._preempted) else (
+                0 if local_done else 1
+            )
+            votes = np.asarray(
+                multihost_utils.process_allgather(np.int32(mine))
+            )
+            if votes.max() == 2:
+                self._preempt_agreed = True
+                return  # a host was preempted: stop everyone at this step
+            if votes.max() == 0:
+                return  # every host is out of data
+            if item is not None:
+                last = (item[1], item[2])
+                yield item
+            else:
+                # keep collectives aligned while other hosts still train
+                if pad is None:
+                    pad = self._empty_batch()
+                yield pad, last[0], last[1]
+
     def prepare_batch(self, batch: Batch) -> Batch:
         """Bring an externally built Batch (raw hash-space keys, see
         io/batch.py) into this model's key space: apply the hot remap
@@ -263,11 +340,16 @@ class Trainer:
         cfg = self.cfg
         t0 = time.time()
         steps = 0
+        preempted = False
         device_metrics = []  # fetched once at epoch end to keep dispatch async
         profiling = False
-        for batch, shard_idx, resume in self.iter_train_batches(
-            start_shard, start_offset
+        self._preempt_agreed = False
+        last_cursor = (start_shard, start_offset)
+        for batch, shard_idx, resume in self._synced_batches(
+            self.iter_train_batches(start_shard, start_offset),
+            vote_preempt=True,
         ):
+            last_cursor = (shard_idx, resume)
             if (
                 cfg.profile_dir
                 and not self._profiled
@@ -291,6 +373,15 @@ class Trainer:
                 steps % cfg.checkpoint_every_steps == 0
             ):
                 self.save(shard_idx, resume)
+            if self.num_hosts == 1 and self._preempted:
+                self.save(shard_idx, resume)
+                preempted = True
+                break
+        if self._preempt_agreed:
+            # multi-host: every process left the loop at the same step;
+            # the (collective) save is safe here
+            self.save(*last_cursor)
+            preempted = True
         if profiling:  # epoch ended inside the profile window
             if device_metrics:
                 jax.device_get(device_metrics[-1]["logloss"])  # flush
@@ -309,28 +400,78 @@ class Trainer:
             "train_logloss": ll_sum / max(seen, 1.0),
             "examples_per_sec": seen / max(dt, 1e-9),
             "seconds": dt,
+            "preempted": preempted,
         }
 
     def train(self) -> list[dict]:
         """Full training run (reference batch_training loop over epochs,
-        lr_worker.cc:179-205, with epoch banner every 30 at :202)."""
+        lr_worker.cc:179-205, with epoch banner every 30 at :202).
+
+        Graceful preemption (capability gap vs the reference, whose only
+        recovery story was ``pkill -9`` + full restart — SURVEY §5):
+        with checkpointing enabled, SIGTERM/SIGINT during training
+        finishes the in-flight step, saves weights + optimizer state +
+        data cursor, and returns cleanly; a later run with --resume
+        continues mid-shard.
+        """
         history = []
-        while self.epoch < self.cfg.epochs:
-            start_shard, start_offset = self._resume_cursor
-            self._resume_cursor = (0, 0)
-            stats = self.train_epoch(start_shard, start_offset)
-            history.append(stats)
-            if self.metrics_logger is not None:
-                self.metrics_logger.log("train_epoch", stats)
-            if self.epoch % 30 == 0 or self.epoch == self.cfg.epochs - 1:
-                self._log(
-                    f"epoch {self.epoch}: logloss={stats['train_logloss']:.6f} "
-                    f"examples/s={stats['examples_per_sec']:.0f}"
-                )
-            self.epoch += 1
-            if self.cfg.checkpoint_dir:
-                self.save(0, 0)
+        restore_handlers = self._install_preemption_handler()
+        try:
+            while self.epoch < self.cfg.epochs:
+                start_shard, start_offset = self._resume_cursor
+                self._resume_cursor = (0, 0)
+                stats = self.train_epoch(start_shard, start_offset)
+                history.append(stats)
+                if self.metrics_logger is not None:
+                    self.metrics_logger.log("train_epoch", stats)
+                if self.epoch % 30 == 0 or self.epoch == self.cfg.epochs - 1:
+                    self._log(
+                        f"epoch {self.epoch}: logloss={stats['train_logloss']:.6f} "
+                        f"examples/s={stats['examples_per_sec']:.0f}"
+                    )
+                if stats.get("preempted"):
+                    break
+                self.epoch += 1
+                if self.cfg.checkpoint_dir:
+                    self.save(0, 0)
+        finally:
+            restore_handlers()
         return history
+
+    def _install_preemption_handler(self) -> Callable[[], None]:
+        """Install SIGTERM/SIGINT → checkpoint-and-stop handlers (only
+        with checkpointing on, only from the main thread).  Returns a
+        restore function.  The handler fires ONCE and then restores the
+        previous handlers, so a second signal escalates normally (e.g.
+        a second Ctrl-C kills a wedged step instead of being swallowed).
+        """
+        self._preempted = False
+        self._preempt_agreed = False
+        if not self.cfg.checkpoint_dir:
+            return lambda: None
+        import signal
+
+        prev = {}
+
+        def restore():
+            for sig, h in prev.items():
+                signal.signal(sig, h)
+            prev.clear()
+
+        def on_signal(signum, frame):
+            self._log(
+                f"signal {signum}: finishing step, checkpointing, stopping "
+                "(send again to force)"
+            )
+            self._preempted = True
+            restore()
+
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                prev[sig] = signal.signal(sig, on_signal)
+        except ValueError:  # not the main thread — no handler possible
+            return lambda: None
+        return restore
 
     # -- evaluation --------------------------------------------------------
 
@@ -341,23 +482,37 @@ class Trainer:
         out_path = pred_out if pred_out is not None else cfg.pred_out
         if out_path and self.host == 0:
             pred_file = open(out_path, "w")
-        try:
+        def batches() -> Iterator[tuple[Batch, int, int]]:
             workers = self._parse_workers()
             for path in self._my_shards(cfg.test_path):
                 # Reference predict uses doubled block size (lr_worker.cc:80).
                 loader = self._loader(path)
                 loader.block_bytes = (cfg.block_mib * 2) << 20
-                for batch, _ in loader.prefetch(
+                for batch, resume in loader.prefetch(
                     cfg.prefetch_batches, parse_workers=workers
                 ):
-                    arrays = self.step.put_batch(batch)
-                    pctr = np.asarray(jax.device_get(self.step.predict(self.state, arrays)))
-                    acc.add(batch.labels, pctr, batch.weights)
-                    if pred_file is not None:
-                        for y, p, w in zip(batch.labels, pctr, batch.weights):
-                            if w > 0:
-                                # "(label, pctr)" lines, lr_worker.cc:62-68.
-                                pred_file.write(f"{int(y)}\t{p:.6f}\n")
+                    yield batch, 0, resume
+
+        try:
+            # predict is collective too — keep hosts step-aligned
+            for batch, _, _ in self._synced_batches(batches()):
+                arrays = self.step.put_batch(batch)
+                garr = self.step.predict(self.state, arrays)
+                if self.num_hosts > 1:
+                    # inverse of put_batch's host-local→global assembly:
+                    # this host's rows of the sharded pctr
+                    from jax.experimental import multihost_utils
+
+                    garr = multihost_utils.global_array_to_host_local_array(
+                        garr, self.mesh, self.step._bsharding.spec
+                    )
+                pctr = np.asarray(jax.device_get(garr))
+                acc.add(batch.labels, pctr, batch.weights)
+                if pred_file is not None:
+                    for y, p, w in zip(batch.labels, pctr, batch.weights):
+                        if w > 0:
+                            # "(label, pctr)" lines, lr_worker.cc:62-68.
+                            pred_file.write(f"{int(y)}\t{p:.6f}\n")
         finally:
             if pred_file is not None:
                 pred_file.close()

@@ -25,6 +25,17 @@ import jax
 MANIFEST = "manifest.json"
 
 
+def _to_host(arr) -> np.ndarray:
+    """Materialize a (possibly multi-host-sharded) array on this host.
+    COLLECTIVE in multi-process runs — every process must call it for
+    every array in the same order."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    return np.asarray(jax.device_get(arr))
+
+
 def save_checkpoint(
     directory: str,
     state: dict[str, Any],
@@ -32,22 +43,30 @@ def save_checkpoint(
     config_json: str | None = None,
 ) -> str:
     """Write one checkpoint; returns its path.  ``state`` is the train
-    step's pytree; ``cursor`` is loader position metadata."""
+    step's pytree; ``cursor`` is loader position metadata.
+
+    Multi-host: COLLECTIVE — all processes must call it together (the
+    sharded tables are allgathered); process 0 writes the files (the
+    checkpoint directory is assumed shared or only rank 0's artifacts
+    are used, matching rank-0-only artifact conventions elsewhere)."""
     step = int(jax.device_get(state["step"]))
-    os.makedirs(directory, exist_ok=True)
     final = os.path.join(directory, f"ckpt-{step:010d}")
+    # materialize first (collective section — identical order everywhere)
+    items: list[tuple[str, str, np.ndarray]] = []
+    for tname, table in state["tables"].items():
+        for aname, arr in table.items():
+            items.append((f"{tname}.{aname}.npy", f"{tname}/{aname}", _to_host(arr)))
+    for dname, arr in state.get("dense", {}).items():
+        items.append((f"dense.{dname}.npy", f"dense/{dname}", _to_host(arr)))
+    if jax.process_index() != 0:
+        return final
+    os.makedirs(directory, exist_ok=True)
     tmp = tempfile.mkdtemp(prefix=".tmp-ckpt-", dir=directory)
     try:
         arrays: dict[str, str] = {}
-        for tname, table in state["tables"].items():
-            for aname, arr in table.items():
-                fname = f"{tname}.{aname}.npy"
-                np.save(os.path.join(tmp, fname), np.asarray(jax.device_get(arr)))
-                arrays[f"{tname}/{aname}"] = fname
-        for dname, arr in state.get("dense", {}).items():
-            fname = f"dense.{dname}.npy"
-            np.save(os.path.join(tmp, fname), np.asarray(jax.device_get(arr)))
-            arrays[f"dense/{dname}"] = fname
+        for fname, key, host_arr in items:
+            np.save(os.path.join(tmp, fname), host_arr)
+            arrays[key] = fname
         manifest = {
             "step": step,
             "arrays": arrays,
@@ -98,22 +117,8 @@ def load_checkpoint(
     another (row-sharding is resharded by XLA)."""
     with open(os.path.join(path, MANIFEST)) as f:
         manifest = json.load(f)
-    new_tables: dict[str, Any] = {}
-    for tname, table in state["tables"].items():
-        new_tables[tname] = {}
-        for aname, arr in table.items():
-            key = f"{tname}/{aname}"
-            if key not in manifest["arrays"]:
-                raise ValueError(f"checkpoint {path} missing array {key}")
-            host = np.load(os.path.join(path, manifest["arrays"][key]))
-            if host.shape != arr.shape:
-                raise ValueError(
-                    f"checkpoint array {key} shape {host.shape} != state {arr.shape}"
-                )
-            new_tables[tname][aname] = jax.device_put(host, arr.sharding)
-    new_dense = {}
-    for dname, arr in state.get("dense", {}).items():
-        key = f"dense/{dname}"
+
+    def restore_one(key: str, arr):
         if key not in manifest["arrays"]:
             raise ValueError(f"checkpoint {path} missing array {key}")
         host = np.load(os.path.join(path, manifest["arrays"][key]))
@@ -121,7 +126,22 @@ def load_checkpoint(
             raise ValueError(
                 f"checkpoint array {key} shape {host.shape} != state {arr.shape}"
             )
-        new_dense[dname] = jax.device_put(host, arr.sharding)
+        # each process feeds only its addressable shards from the full
+        # host copy — works for single-host and multi-host meshes alike
+        return jax.make_array_from_callback(
+            host.shape, arr.sharding, lambda idx: host[idx]
+        )
+
+    new_tables: dict[str, Any] = {}
+    for tname, table in state["tables"].items():
+        new_tables[tname] = {
+            aname: restore_one(f"{tname}/{aname}", arr)
+            for aname, arr in table.items()
+        }
+    new_dense = {
+        dname: restore_one(f"dense/{dname}", arr)
+        for dname, arr in state.get("dense", {}).items()
+    }
     import jax.numpy as jnp
 
     new_state = {
