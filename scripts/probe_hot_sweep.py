@@ -39,7 +39,7 @@ def main():
         )
     for name, cfg in configs:
         step, state = build(accel, cfg)
-        batches = make_batches(cfg, 2)
+        batches, _ = make_batches(cfg, 2)
         _, eps = run(step, state, batches, iters=10, warmup=2)
         print(f"{name:18s} {eps/1e6:6.3f} M ex/s", flush=True)
 
