@@ -24,6 +24,31 @@ def shard_path(prefix: str, rank: int) -> str:
     return f"{prefix}-{rank:05d}"  # reference: lr_worker.cc:210
 
 
+def _concat_blocks(a: ParsedBlock, b: ParsedBlock) -> ParsedBlock:
+    """CSR concatenation (carry ∥ next block)."""
+    import numpy as np
+
+    return ParsedBlock(
+        labels=np.concatenate([a.labels, b.labels]),
+        row_ptr=np.concatenate([a.row_ptr, b.row_ptr[1:] + a.row_ptr[-1]]),
+        keys=np.concatenate([a.keys, b.keys]),
+        slots=np.concatenate([a.slots, b.slots]),
+        vals=np.concatenate([a.vals, b.vals]),
+    )
+
+
+def _slice_block(block: ParsedBlock, start: int) -> ParsedBlock:
+    """CSR tail slice: samples [start, n)."""
+    lo = block.row_ptr[start]
+    return ParsedBlock(
+        labels=block.labels[start:],
+        row_ptr=block.row_ptr[start:] - lo,
+        keys=block.keys[lo:],
+        slots=block.slots[lo:],
+        vals=block.vals[lo:],
+    )
+
+
 ParseFn = Callable[[bytes], ParsedBlock]
 
 
@@ -75,68 +100,124 @@ class ShardLoader:
         self.remap = remap
         self.hot_size = hot_size
         self.hot_nnz = hot_nnz
+        # Native pack folds remap + hot steering + padding into one C
+        # pass (xf_pack_batch); the numpy fallback applies the remap at
+        # parse time and pads/steers with pack_batch.
+        from xflow_tpu import native
 
-    def _block_to_batches(
-        self, raw: bytes, offset: int, next_offset: int
-    ) -> list[tuple[Batch, int]]:
+        self._native_pack = native.available()
+
+    def _parse_remap(self, raw: bytes) -> ParsedBlock:
         block = self.parse_fn(raw)
-        if self.remap is not None and len(block.keys):
+        if (
+            self.remap is not None
+            and not self._native_pack
+            and len(block.keys)
+        ):
             # frequency remap: pure row-placement permutation (io/freq.py)
             block.keys = self.remap[block.keys]
-        out = []
-        n = block.num_samples
-        for start in range(0, n, self.batch_size):
-            end = min(start + self.batch_size, n)
-            out.append(
-                (
-                    pack_batch(
-                        block, start, end, self.batch_size, self.max_nnz,
-                        self.hot_size, self.hot_nnz,
-                    ),
-                    offset if end < n else next_offset,
-                )
+        return block
+
+    def _pack(self, block: ParsedBlock, start: int, end: int) -> Batch:
+        if self._native_pack:
+            from xflow_tpu.native import native_pack_batch
+
+            return native_pack_batch(
+                block, start, end, self.batch_size, self.max_nnz,
+                self.hot_size, self.hot_nnz, self.remap,
             )
-        return out
+        return pack_batch(
+            block, start, end, self.batch_size, self.max_nnz,
+            self.hot_size, self.hot_nnz,
+        )
 
     def iter_batches(
         self, start_offset: int = 0, parse_workers: int = 0
     ) -> Iterator[tuple[Batch, int]]:
         """Yield (batch, resume_offset) pairs for one pass over the shard.
 
-        ``resume_offset`` is the byte offset of the first block not yet
-        fully consumed — pass it back as ``start_offset`` to resume.
+        Batches are FULL (batch_size real examples) regardless of the
+        text block size: parsed blocks accumulate in a carry buffer and
+        only the shard's final batch is zero-weight padded.  (Without
+        this, block_bytes ≪ batch_size lines would make every batch
+        mostly padding — wasted device cycles.)
 
-        With parse_workers > 1, whole blocks parse+pack concurrently on a
-        thread pool, order-preserving (the native parser and numpy both
-        release the GIL for the heavy part) — the TPU-era replacement for
-        the reference's per-minibatch ThreadPool fan-out
+        ``resume_offset`` is the byte offset of the earliest block with
+        samples not yet yielded — pass it back as ``start_offset`` to
+        resume; up to one block plus one carry may replay (resume
+        granularity is the block, as in the reference's block loader,
+        load_data_from_disk.cc:103-124).
+
+        With parse_workers > 1, whole blocks parse+remap concurrently on
+        a thread pool, order-preserving (the native parser and numpy
+        release the GIL for the heavy part) — the TPU-era replacement
+        for the reference's per-minibatch ThreadPool fan-out
         (lr_worker.cc:190-196).
         """
         with open(self.path, "rb") as f:
             f.seek(start_offset)
-            offset = start_offset
-            if parse_workers <= 1:
-                for raw in BlockReader(f, self.block_bytes):
-                    next_offset = offset + len(raw)
-                    yield from self._block_to_batches(raw, offset, next_offset)
-                    offset = next_offset
-                return
 
-            from collections import deque
-            from concurrent.futures import ThreadPoolExecutor
+            def parsed_blocks() -> Iterator[tuple[ParsedBlock, int, int]]:
+                offset = start_offset
+                if parse_workers <= 1:
+                    for raw in BlockReader(f, self.block_bytes):
+                        next_offset = offset + len(raw)
+                        yield self._parse_remap(raw), offset, next_offset
+                        offset = next_offset
+                    return
+                from collections import deque
+                from concurrent.futures import ThreadPoolExecutor
 
-            with ThreadPoolExecutor(max_workers=parse_workers) as ex:
-                pending: deque = deque()
-                for raw in BlockReader(f, self.block_bytes):
-                    next_offset = offset + len(raw)
-                    pending.append(
-                        ex.submit(self._block_to_batches, raw, offset, next_offset)
-                    )
-                    offset = next_offset
-                    while len(pending) > parse_workers + 1:
-                        yield from pending.popleft().result()
-                while pending:
-                    yield from pending.popleft().result()
+                with ThreadPoolExecutor(max_workers=parse_workers) as ex:
+                    pending: deque = deque()
+                    for raw in BlockReader(f, self.block_bytes):
+                        next_offset = offset + len(raw)
+                        pending.append(
+                            (ex.submit(self._parse_remap, raw), offset, next_offset)
+                        )
+                        offset = next_offset
+                        while len(pending) > parse_workers + 1:
+                            fut, off, noff = pending.popleft()
+                            yield fut.result(), off, noff
+                    while pending:
+                        fut, off, noff = pending.popleft()
+                        yield fut.result(), off, noff
+
+            carry: ParsedBlock | None = None
+            carry_offset = start_offset
+            end_offset = start_offset
+            for block, raw_offset, next_offset in parsed_blocks():
+                end_offset = next_offset
+                n_carry = 0
+                if carry is not None and carry.num_samples:
+                    n_carry = carry.num_samples
+                    block = _concat_blocks(carry, block)
+                carry = None
+                n = block.num_samples
+                start = 0
+                while n - start >= self.batch_size:
+                    end = start + self.batch_size
+                    # resume = earliest block holding a not-yet-yielded
+                    # sample: past the carry it's this raw block, else the
+                    # block the carry came from; end == n consumed it all
+                    if end == n:
+                        resume = next_offset
+                    elif end >= n_carry:
+                        resume = raw_offset
+                    else:
+                        resume = carry_offset
+                    yield self._pack(block, start, end), resume
+                    start = end
+                if start < n:
+                    carry = _slice_block(block, start)
+                    if start >= n_carry:
+                        # remainder lies entirely in this raw block
+                        carry_offset = raw_offset
+                    # else: keep the old carry_offset (remainder still
+                    # includes samples from the earlier block)
+            if carry is not None and carry.num_samples:
+                # the stream's final (partial) batch consumes everything
+                yield self._pack(carry, 0, carry.num_samples), end_offset
 
     def prefetch(
         self, depth: int, start_offset: int = 0, parse_workers: int = 0

@@ -2,7 +2,14 @@ from xflow_tpu.native.ffi import (
     available,
     load_library,
     native_murmur64,
+    native_pack_batch,
     native_parse_block,
 )
 
-__all__ = ["available", "load_library", "native_murmur64", "native_parse_block"]
+__all__ = [
+    "available",
+    "load_library",
+    "native_murmur64",
+    "native_pack_batch",
+    "native_parse_block",
+]

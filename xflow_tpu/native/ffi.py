@@ -32,33 +32,62 @@ def load_library() -> ctypes.CDLL | None:
 
             path = build_if_needed()
             lib = ctypes.CDLL(str(path))
+            _bind(lib)
         except Exception:
             _load_failed = True
             return None
-        lib.xf_murmur64.restype = ctypes.c_uint64
-        lib.xf_murmur64.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_int64,
-            ctypes.c_uint64,
-        ]
-        lib.xf_parse_block.restype = ctypes.c_int64
-        lib.xf_parse_block.argtypes = [
-            ctypes.c_char_p,  # data
-            ctypes.c_int64,  # len
-            ctypes.c_int64,  # table_size
-            ctypes.c_int,  # hash_mode
-            ctypes.c_uint64,  # seed
-            ctypes.POINTER(ctypes.c_float),  # labels
-            ctypes.c_int64,  # max_rows
-            ctypes.POINTER(ctypes.c_int64),  # row_ptr
-            ctypes.POINTER(ctypes.c_int64),  # keys
-            ctypes.POINTER(ctypes.c_int32),  # slots
-            ctypes.POINTER(ctypes.c_float),  # vals
-            ctypes.c_int64,  # max_nnz
-            ctypes.POINTER(ctypes.c_int64),  # out_nnz
-        ]
         _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    """Declare signatures; raises (caught by load_library) if a symbol
+    is missing — e.g. a stale cached .so from an older source version
+    whose mtime check passed (equal-mtime extraction)."""
+    lib.xf_murmur64.restype = ctypes.c_uint64
+    lib.xf_murmur64.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_uint64,
+    ]
+    lib.xf_parse_block.restype = ctypes.c_int64
+    lib.xf_parse_block.argtypes = [
+        ctypes.c_char_p,  # data
+        ctypes.c_int64,  # len
+        ctypes.c_int64,  # table_size
+        ctypes.c_int,  # hash_mode
+        ctypes.c_uint64,  # seed
+        ctypes.POINTER(ctypes.c_float),  # labels
+        ctypes.c_int64,  # max_rows
+        ctypes.POINTER(ctypes.c_int64),  # row_ptr
+        ctypes.POINTER(ctypes.c_int64),  # keys
+        ctypes.POINTER(ctypes.c_int32),  # slots
+        ctypes.POINTER(ctypes.c_float),  # vals
+        ctypes.c_int64,  # max_nnz
+        ctypes.POINTER(ctypes.c_int64),  # out_nnz
+    ]
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.xf_pack_batch.restype = ctypes.c_int64
+    lib.xf_pack_batch.argtypes = [
+        i64p,  # row_ptr
+        f32p,  # labels_in
+        i64p,  # keys_in
+        i32p,  # slots_in
+        f32p,  # vals_in
+        ctypes.c_int64,  # start
+        ctypes.c_int64,  # end
+        ctypes.c_int64,  # batch_size
+        i32p,  # remap (nullable)
+        ctypes.c_int64,  # hot_size
+        ctypes.c_int64,  # hot_nnz
+        ctypes.c_int64,  # cold_nnz
+        i32p, i32p, f32p, f32p,  # keys, slots, vals, mask
+        i32p, i32p, f32p, f32p,  # hot_keys/slots/vals/mask (nullable)
+        f32p,  # labels
+        f32p,  # weights
+    ]
 
 
 def available() -> bool:
@@ -119,4 +148,80 @@ def native_parse_block(
         keys=keys[:nnz].copy(),
         slots=slots[:nnz].copy(),
         vals=vals[:nnz].copy(),
+    )
+
+
+def native_pack_batch(
+    block: ParsedBlock,
+    start: int,
+    end: int,
+    batch_size: int,
+    max_nnz: int,
+    hot_size: int = 0,
+    hot_nnz: int = 0,
+    remap: np.ndarray | None = None,
+):
+    """Drop-in replacement for io.batch.pack_batch with the frequency
+    remap folded in (parity enforced by tests/test_native.py).  ``block``
+    must hold RAW (un-remapped) keys when ``remap`` is given."""
+    from xflow_tpu.io.batch import Batch
+
+    lib = load_library()
+    assert lib is not None, "native library unavailable"
+    n = end - start
+    assert 0 < n <= batch_size
+    kh = hot_nnz if hot_size else 0
+    row_ptr = np.ascontiguousarray(block.row_ptr, dtype=np.int64)
+    labels_in = np.ascontiguousarray(block.labels, dtype=np.float32)
+    keys_in = np.ascontiguousarray(block.keys, dtype=np.int64)
+    slots_in = np.ascontiguousarray(block.slots, dtype=np.int32)
+    vals_in = np.ascontiguousarray(block.vals, dtype=np.float32)
+    if remap is not None:
+        remap = np.ascontiguousarray(remap, dtype=np.int32)
+
+    keys = np.empty((batch_size, max_nnz), np.int32)
+    slots = np.empty((batch_size, max_nnz), np.int32)
+    vals = np.empty((batch_size, max_nnz), np.float32)
+    mask = np.empty((batch_size, max_nnz), np.float32)
+    hot_keys = np.empty((batch_size, kh), np.int32)
+    hot_slots = np.empty((batch_size, kh), np.int32)
+    hot_vals = np.empty((batch_size, kh), np.float32)
+    hot_mask = np.empty((batch_size, kh), np.float32)
+    labels = np.empty(batch_size, np.float32)
+    weights = np.empty(batch_size, np.float32)
+    null_i32 = ctypes.POINTER(ctypes.c_int32)()
+    lib.xf_pack_batch(
+        _ptr(row_ptr, ctypes.c_int64),
+        _ptr(labels_in, ctypes.c_float),
+        _ptr(keys_in, ctypes.c_int64),
+        _ptr(slots_in, ctypes.c_int32),
+        _ptr(vals_in, ctypes.c_float),
+        start,
+        end,
+        batch_size,
+        _ptr(remap, ctypes.c_int32) if remap is not None else null_i32,
+        hot_size if kh else 0,
+        kh,
+        max_nnz,
+        _ptr(keys, ctypes.c_int32),
+        _ptr(slots, ctypes.c_int32),
+        _ptr(vals, ctypes.c_float),
+        _ptr(mask, ctypes.c_float),
+        _ptr(hot_keys, ctypes.c_int32),
+        _ptr(hot_slots, ctypes.c_int32),
+        _ptr(hot_vals, ctypes.c_float),
+        _ptr(hot_mask, ctypes.c_float),
+        _ptr(labels, ctypes.c_float),
+        _ptr(weights, ctypes.c_float),
+    )
+    if not kh:
+        return Batch(
+            keys=keys, slots=slots, vals=vals, mask=mask,
+            labels=labels, weights=weights,
+        )
+    return Batch(
+        keys=keys, slots=slots, vals=vals, mask=mask,
+        labels=labels, weights=weights,
+        hot_keys=hot_keys, hot_slots=hot_slots,
+        hot_vals=hot_vals, hot_mask=hot_mask,
     )

@@ -229,4 +229,82 @@ int64_t xf_parse_block(const char* data, int64_t len, int64_t table_size,
   return n_rows;
 }
 
+// Packs samples [start, end) of a parsed CSR block into padded
+// row-major batch arrays, folding in the optional frequency remap
+// (io/freq.py) and hot/cold steering (io/batch.py::split_hot) in one
+// pass.  Native counterpart of io/batch.py::pack_batch — the numpy
+// version's cumsum/nonzero/fancy-index pipeline is the host bottleneck
+// at large batch sizes; parity enforced by tests/test_native.py.
+//
+// Layout contract (matches pack_batch exactly):
+//   * per sample, at most (cold_nnz + hot_nnz) leading CSR entries are
+//     considered (the rest truncate, as the Python ktot cap);
+//   * among those, hot entries (remapped key < hot_size) fill the hot
+//     section in order up to hot_nnz; overflow spills to cold;
+//   * cold entries fill up to cold_nnz, then truncate;
+//   * pad feature slots are key/slot/val/mask = 0; pad samples (index
+//     >= end-start) are fully zero with weight 0.
+// Outputs may be uninitialized (np.empty): every slot is written.
+// hot_* pointers may be null when hot_nnz == 0.  remap may be null.
+int64_t xf_pack_batch(const int64_t* row_ptr, const float* labels_in,
+                      const int64_t* keys_in, const int32_t* slots_in,
+                      const float* vals_in, int64_t start, int64_t end,
+                      int64_t batch_size, const int32_t* remap,
+                      int64_t hot_size, int64_t hot_nnz, int64_t cold_nnz,
+                      int32_t* keys, int32_t* slots, float* vals, float* mask,
+                      int32_t* hot_keys, int32_t* hot_slots, float* hot_vals,
+                      float* hot_mask, float* labels, float* weights) {
+  const int64_t n = end - start;
+  const int64_t ktot = cold_nnz + hot_nnz;
+  for (int64_t i = 0; i < batch_size; ++i) {
+    int32_t* krow = keys + i * cold_nnz;
+    int32_t* srow = slots + i * cold_nnz;
+    float* vrow = vals + i * cold_nnz;
+    float* mrow = mask + i * cold_nnz;
+    int64_t cold = 0;
+    int64_t hot = 0;
+    if (i < n) {
+      labels[i] = labels_in[start + i];
+      weights[i] = 1.0f;
+      const int64_t lo = row_ptr[start + i];
+      int64_t hi = row_ptr[start + i + 1];
+      if (hi - lo > ktot) hi = lo + ktot;  // Python ktot truncation
+      for (int64_t e = lo; e < hi; ++e) {
+        int64_t k = keys_in[e];
+        if (remap != nullptr) k = remap[k];
+        if (k < hot_size && hot < hot_nnz) {
+          hot_keys[i * hot_nnz + hot] = static_cast<int32_t>(k);
+          hot_slots[i * hot_nnz + hot] = slots_in[e];
+          hot_vals[i * hot_nnz + hot] = vals_in[e];
+          hot_mask[i * hot_nnz + hot] = 1.0f;
+          ++hot;
+        } else if (cold < cold_nnz) {
+          krow[cold] = static_cast<int32_t>(k);
+          srow[cold] = slots_in[e];
+          vrow[cold] = vals_in[e];
+          mrow[cold] = 1.0f;
+          ++cold;
+        }  // else: cold capacity truncation (split_hot semantics)
+      }
+    } else {
+      labels[i] = 0.0f;
+      weights[i] = 0.0f;
+    }
+    // zero-fill pad slots (outputs may be np.empty)
+    const size_t cpad = static_cast<size_t>(cold_nnz - cold);
+    std::memset(krow + cold, 0, cpad * sizeof(int32_t));
+    std::memset(srow + cold, 0, cpad * sizeof(int32_t));
+    std::memset(vrow + cold, 0, cpad * sizeof(float));
+    std::memset(mrow + cold, 0, cpad * sizeof(float));
+    if (hot_nnz > 0) {
+      const size_t hpad = static_cast<size_t>(hot_nnz - hot);
+      std::memset(hot_keys + i * hot_nnz + hot, 0, hpad * sizeof(int32_t));
+      std::memset(hot_slots + i * hot_nnz + hot, 0, hpad * sizeof(int32_t));
+      std::memset(hot_vals + i * hot_nnz + hot, 0, hpad * sizeof(float));
+      std::memset(hot_mask + i * hot_nnz + hot, 0, hpad * sizeof(float));
+    }
+  }
+  return n;
+}
+
 }  // extern "C"
