@@ -96,3 +96,88 @@ def test_two_process_training(toy_dataset, tmp_path, hot):
         assert procs[0].returncode == 0, errs[0]
         assert procs[1].returncode == 0, errs[1]
         assert "resumed at" in errs[0]
+
+
+def test_two_process_midepoch_cursor_resume(toy_dataset, tmp_path):
+    """Mid-epoch checkpoints record EVERY host's (shard, offset) cursor
+    and each host resumes from its own — the round-1 advisor finding:
+    rank 0's byte offset must not be applied to other hosts' different
+    shard subsets."""
+    import json
+
+    port = _free_port()
+    env_base = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+    )
+    ck = tmp_path / "ck"
+    cmd = [
+        sys.executable, "-m", "xflow_tpu.train",
+        "--model", "lr",
+        "--train", toy_dataset.train_prefix,  # 3 shards -> unequal split
+        "--test", toy_dataset.test_prefix,
+        "--epochs", "1",
+        "--batch-size", "32",
+        "--block-mib", "1",
+        "--table-size-log2", "14",
+        "--max-nnz", "24",
+        "--num-devices", "2",
+        "--platform", "cpu",
+        "--coordinator", f"localhost:{port}",
+        "--num-processes", "2",
+        "--checkpoint-dir", str(ck),
+        "--checkpoint-every-steps", "2",
+        "--skip-eval",
+    ]
+
+    def run_pair(extra, port):
+        cmd2 = list(cmd)
+        cmd2[cmd2.index("--coordinator") + 1] = f"localhost:{port}"
+        procs = [
+            subprocess.Popen(
+                cmd2 + extra + ["--process-id", str(pid)],
+                env=env_base, stderr=subprocess.PIPE, text=True,
+                cwd=os.getcwd(),
+            )
+            for pid in range(2)
+        ]
+        errs = []
+        for p in procs:
+            try:
+                _, err = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("distributed run deadlocked")
+            errs.append(err)
+        assert procs[0].returncode == 0, errs[0]
+        assert procs[1].returncode == 0, errs[1]
+        return errs
+
+    run_pair([], port)
+    # every checkpoint (intermediate + final) carries both hosts' cursors
+    import glob as _glob
+
+    ckpts = sorted(_glob.glob(str(ck / "ckpt-*")))
+    assert len(ckpts) >= 2  # at least one mid-epoch + the final
+    manifests = [
+        json.load(open(os.path.join(c, "manifest.json"))) for c in ckpts
+    ]
+    for m in manifests:
+        assert m["cursor"]["num_hosts"] == 2
+        assert len(m["cursor"]["cursors"]) == 2
+    # host 0 owns shards {0,2}, host 1 owns {1}: once host 0 crosses into
+    # its second local shard (or host 1 finishes first), the two hosts'
+    # cursors MUST diverge in some mid-epoch checkpoint — rank 0's cursor
+    # alone could not describe both (the round-1 advisor bug)
+    assert any(
+        m["cursor"]["cursors"][0] != m["cursor"]["cursors"][1]
+        for m in manifests[:-1]
+    )
+
+    # resume from the mid-epoch checkpoint: point LATEST at it
+    with open(ck / "LATEST", "w") as f:
+        f.write(os.path.basename(ckpts[0]))
+    errs = run_pair(["--resume"], _free_port())
+    assert "resumed at" in errs[0]

@@ -67,3 +67,76 @@ def test_accumulator_streams():
     ll, auc = acc.compute()
     assert auc == 1.0
     assert np.isfinite(ll)
+
+
+def test_hist_auc_matches_exact():
+    """HistAuc (multi-host streaming path) ≈ pairwise rank-sum AUC;
+    logloss is exact (it sums — no quantization)."""
+    from xflow_tpu.utils.metrics import AucAccumulator, HistAuc
+
+    rng = np.random.default_rng(3)
+    labels = (rng.random(20000) < 0.3).astype(np.float32)
+    pctr = np.clip(
+        rng.beta(2, 5, 20000) + labels * 0.1, 0, 1
+    ).astype(np.float32)
+    acc, hist = AucAccumulator(), HistAuc()
+    for s in range(0, 20000, 4096):  # streaming in chunks
+        acc.add(labels[s : s + 4096], pctr[s : s + 4096])
+        hist.add(labels[s : s + 4096], pctr[s : s + 4096])
+    ll_a, auc_a = acc.compute()
+    ll_h, auc_h = hist.compute()
+    # pairwise path accumulates in float32, histogram in float64
+    assert abs(ll_a - ll_h) < 1e-6
+    assert abs(auc_a - auc_h) < 1e-4
+    # mergeable state: two half-streams summed == one stream
+    h1, h2 = HistAuc(), HistAuc()
+    h1.add(labels[:10000], pctr[:10000])
+    h2.add(labels[10000:], pctr[10000:])
+    merged = HistAuc.from_state(
+        {
+            k: np.asarray(h1.state()[k]) + np.asarray(h2.state()[k])
+            for k in h1.state()
+        }
+    )
+    np.testing.assert_allclose(merged.compute(), hist.compute(), rtol=1e-12)
+
+
+def test_auc_tie_semantics_bounds():
+    """Tie-heavy golden test (VERDICT round 1).  The reference's AUC
+    under tied pctrs depends on std::sort's arbitrary permutation
+    (base.h:89-106: each negative counts positives EARLIER in sort
+    order, so within a tied group the area can be anything between 0 and
+    p_g*n_g extra).  Contract: our exact accumulator must land inside
+    the reference's achievable [min, max] envelope, and the histogram
+    path must sit exactly at the midpoint (midrank)."""
+    from xflow_tpu.utils.metrics import AucAccumulator, HistAuc, auc_rank_sum
+
+    rng = np.random.default_rng(11)
+    # 5 distinct pctr levels, 400 samples each -> massive tie groups
+    levels = np.asarray([0.1, 0.3, 0.5, 0.7, 0.9], np.float32)
+    pctr = np.repeat(levels, 400)
+    labels = (rng.random(2000) < np.repeat(levels, 400)).astype(np.float32)
+    perm = rng.permutation(2000)
+    pctr, labels = pctr[perm], labels[perm]
+
+    # reference envelope: fixed cross-group area +/- within-group freedom
+    fixed = 0.0
+    slack = 0.0
+    p_total = labels.sum()
+    n_total = len(labels) - p_total
+    for lv in levels:
+        g = pctr == lv
+        p_g = labels[g].sum()
+        n_g = g.sum() - p_g
+        p_above = labels[pctr > lv].sum()
+        fixed += n_g * p_above
+        slack += p_g * n_g
+    lo = fixed / (p_total * n_total)
+    hi = (fixed + slack) / (p_total * n_total)
+
+    got = auc_rank_sum(labels, pctr)
+    assert lo - 1e-12 <= got <= hi + 1e-12
+    hist = HistAuc()
+    hist.add(labels, pctr)
+    _, auc_h = hist.compute()
+    np.testing.assert_allclose(auc_h, (lo + hi) / 2, rtol=1e-12)

@@ -5,9 +5,16 @@ file shard named ``<prefix>-%05d`` by rank (lr_worker.cc:210); training
 streams the shard in fixed-size byte blocks per epoch until the loader
 returns no rows (lr_worker.cc:183-189).
 
-New capability (gap filled, SURVEY §5): the loader exposes a resume
-cursor — the byte offset of the next unparsed block — so training can
-checkpoint-and-restart mid-shard.  Resume granularity is one block.
+New capabilities (gaps filled, SURVEY §5):
+
+* batches are FULL across text-block boundaries — parsed blocks
+  accumulate in a carry buffer and only a shard's final batch is
+  zero-weight padded (the reference trains on whatever each 2 MiB block
+  parses to, lr_worker.cc:184-189);
+* a resume cursor per batch — the byte offset of the earliest block
+  holding samples not yet emitted — so training can checkpoint and
+  restart mid-shard.  Replay on resume is bounded by one block plus one
+  carry (< batch_size samples); see iter_batches.
 """
 
 from __future__ import annotations

@@ -517,33 +517,32 @@ class Trainer:
             if pred_file is not None:
                 pred_file.close()
         if self.num_hosts > 1:
-            # Rank-sum AUC is not decomposable over shard subsets: gather
-            # every host's (label, pctr) pairs before computing (the
-            # reference's rank-0 eval sees the whole test shard too,
-            # lr_worker.cc:212-215).  process_allgather needs equal shapes,
-            # so exchange counts first and pad to the max.
+            # Rank-sum AUC is not decomposable over shard subsets.  The
+            # round-1 design allgathered every host's (label, pctr)
+            # pairs — O(test set) memory on EVERY host.  Now each host
+            # folds its pairs into fixed-size histograms (utils.metrics
+            # .HistAuc) and only those reduce across hosts: O(buckets)
+            # traffic/memory regardless of test-set size.  Logloss stays
+            # exact; AUC uses midrank ties (see HistAuc docstring).
             from jax.experimental import multihost_utils
 
+            from xflow_tpu.utils.metrics import HistAuc
+
+            hist = HistAuc()
             labels, pctr = acc.pairs()
-            n_local = len(labels)
-            counts = np.asarray(
-                multihost_utils.process_allgather(np.int64(n_local))
-            ).reshape(-1)
-            pad_to = int(counts.max())
-            padded = {
-                "labels": np.pad(labels, (0, pad_to - n_local)),
-                "pctr": np.pad(pctr, (0, pad_to - n_local)),
+            hist.add(labels, pctr)
+            gathered = multihost_utils.process_allgather(hist.state())
+            summed = {
+                k: np.asarray(v).sum(axis=0) for k, v in gathered.items()
             }
-            gathered = multihost_utils.process_allgather(padded)
-            acc = AucAccumulator()
-            for h in range(len(counts)):
-                acc.add(
-                    np.asarray(gathered["labels"])[h, : counts[h]],
-                    np.asarray(gathered["pctr"])[h, : counts[h]],
-                )
-        ll, auc = acc.compute()
-        n = acc.count()
-        pos = int(acc.pairs()[0].sum()) if n else 0
+            hist = HistAuc.from_state(summed)
+            ll, auc = hist.compute()
+            n = hist.count()
+            pos = hist.num_pos()
+        else:
+            ll, auc = acc.compute()
+            n = acc.count()
+            pos = int(acc.pairs()[0].sum()) if n else 0
         result = {"logloss": ll, "auc": auc, "examples": n, "tp": pos, "fp": n - pos}
         self._log(f"logloss: {ll:.6f}\tauc = {auc:.6f}\ttp = {pos} fp = {n - pos}")
         if self.metrics_logger is not None:
@@ -555,23 +554,68 @@ class Trainer:
     def save(self, shard_idx: int = 0, offset: int = 0) -> str | None:
         if not self.cfg.checkpoint_dir:
             return None
-        cursor = {"epoch": self.epoch, "shard": shard_idx, "offset": offset}
+        # Per-host cursors: shard_idx/offset are HOST-LOCAL (each host
+        # walks its own ``i % num_hosts`` shard subset), so the manifest
+        # records every host's position; a host restores its own.
+        cursors = [{"shard": int(shard_idx), "offset": int(offset)}]
+        if self.num_hosts > 1:
+            from jax.experimental import multihost_utils
+
+            pairs = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([shard_idx, offset], np.int64)
+                )
+            ).reshape(self.num_hosts, 2)
+            cursors = [
+                {"shard": int(s), "offset": int(o)} for s, o in pairs
+            ]
+        cursor = {
+            "epoch": self.epoch,
+            "num_hosts": self.num_hosts,
+            "cursors": cursors,
+            # rank-0 view kept for human inspection of the manifest
+            "shard": cursors[0]["shard"],
+            "offset": cursors[0]["offset"],
+        }
         return save_checkpoint(
             self.cfg.checkpoint_dir, self.state, cursor, self.cfg.to_json()
         )
 
     def restore(self) -> dict | None:
         """Resume from the latest checkpoint if one exists; returns the
-        cursor or None."""
+        cursor or None.  Each host resumes from ITS OWN saved cursor;
+        if the host count changed since the save, the shard→host
+        assignment (``i % num_hosts``) no longer matches and the epoch
+        restarts from the beginning instead of silently skipping or
+        replaying data."""
         if not self.cfg.checkpoint_dir:
             return None
         path = latest_checkpoint(self.cfg.checkpoint_dir)
         if path is None:
             return None
-        self.state, cursor = load_checkpoint(path, self.state)
+        from xflow_tpu.utils.checkpoint import IncompatibleCheckpoint
+
+        try:
+            self.state, cursor = load_checkpoint(path, self.state)
+        except IncompatibleCheckpoint as e:
+            self._log(f"ignoring unusable checkpoint: {e} — starting fresh")
+            return None
         self.epoch = int(cursor.get("epoch", 0))
-        self._resume_cursor = (
-            int(cursor.get("shard", 0)),
-            int(cursor.get("offset", 0)),
-        )
+        cursors = cursor.get("cursors")
+        saved_hosts = int(cursor.get("num_hosts", 1))
+        if cursors is not None and saved_hosts == self.num_hosts:
+            mine = cursors[self.host]
+            self._resume_cursor = (int(mine["shard"]), int(mine["offset"]))
+        elif cursors is not None:
+            self._log(
+                f"checkpoint was saved with {saved_hosts} hosts, now "
+                f"{self.num_hosts}: shard assignment changed — restarting "
+                f"epoch {self.epoch} from the beginning"
+            )
+            self._resume_cursor = (0, 0)
+        else:
+            self._resume_cursor = (
+                int(cursor.get("shard", 0)),
+                int(cursor.get("offset", 0)),
+            )
         return cursor

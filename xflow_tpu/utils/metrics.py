@@ -92,3 +92,98 @@ class AucAccumulator:
         labels = np.concatenate(self._labels) if self._labels else np.zeros(0)
         pctr = np.concatenate(self._pctr) if self._pctr else np.zeros(0)
         return labels, pctr
+
+
+class HistAuc:
+    """Fixed-memory streaming AUC + logloss over quantized pctr buckets.
+
+    Purpose: multi-host evaluation.  Rank-sum AUC is not decomposable
+    over shard subsets, and allgathering every host's (label, pctr)
+    pairs is O(test set) memory per host (round-1 weak point).  Instead
+    each host accumulates two histograms of pctr ∈ [0, 1] (positives /
+    negatives per bucket) plus exact logloss partial sums; histograms
+    ADD across hosts, so the cross-host reduction is O(buckets).
+
+    AUC uses midrank tie handling: pairs in distinct buckets count
+    exactly; pairs sharing a bucket count ½.  With ``buckets = 2^20``
+    the absolute error vs the pairwise statistic is bounded by the
+    fraction of (pos, neg) pairs whose pctrs share a 1e-6-wide bucket —
+    negligible for float32 sigmoid outputs.  (The reference's own tie
+    behavior is std::sort-order-dependent and thus unspecified,
+    base.h:89-106; midrank is the canonical resolution.  Logloss is
+    exact — it sums, no quantization.)
+    """
+
+    def __init__(self, buckets: int = 1 << 20):
+        self.buckets = buckets
+        self.pos = np.zeros(buckets, np.float64)
+        self.neg = np.zeros(buckets, np.float64)
+        self.ll_sum = 0.0
+        self.n = 0.0
+
+    def add(
+        self,
+        labels: np.ndarray,
+        pctr: np.ndarray,
+        weights: np.ndarray | None = None,
+    ) -> None:
+        labels = np.asarray(labels, np.float64)
+        pctr = np.asarray(pctr, np.float64)
+        if weights is not None:
+            keep = np.asarray(weights) > 0
+            labels, pctr = labels[keep], pctr[keep]
+        if not len(labels):
+            return
+        idx = np.clip(
+            (pctr * self.buckets).astype(np.int64), 0, self.buckets - 1
+        )
+        is_pos = labels > 0.5
+        self.pos += np.bincount(
+            idx[is_pos], minlength=self.buckets
+        ).astype(np.float64)
+        self.neg += np.bincount(
+            idx[~is_pos], minlength=self.buckets
+        ).astype(np.float64)
+        p = np.clip(pctr, LOGLOSS_EPS, 1.0 - LOGLOSS_EPS)
+        self.ll_sum += float(
+            -(labels * np.log(p) + (1.0 - labels) * np.log(1.0 - p)).sum()
+        )
+        self.n += float(len(labels))
+
+    def state(self) -> dict[str, np.ndarray]:
+        """Additively mergeable cross-host state."""
+        return {
+            "pos": self.pos,
+            "neg": self.neg,
+            "scalars": np.asarray([self.ll_sum, self.n], np.float64),
+        }
+
+    @classmethod
+    def from_state(cls, state: dict[str, np.ndarray]) -> "HistAuc":
+        out = cls(buckets=int(np.asarray(state["pos"]).shape[-1]))
+        out.pos = np.asarray(state["pos"], np.float64)
+        out.neg = np.asarray(state["neg"], np.float64)
+        out.ll_sum = float(np.asarray(state["scalars"])[0])
+        out.n = float(np.asarray(state["scalars"])[1])
+        return out
+
+    def count(self) -> int:
+        return int(self.n)
+
+    def num_pos(self) -> int:
+        return int(self.pos.sum())
+
+    def compute(self) -> tuple[float, float]:
+        """Returns (logloss_ln, auc)."""
+        if self.n == 0:
+            return float("nan"), float("nan")
+        ll = self.ll_sum / self.n
+        p_total = self.pos.sum()
+        n_total = self.neg.sum()
+        if p_total == 0 or n_total == 0:
+            return float(ll), float("nan")
+        # descending pctr: positives in strictly higher buckets count 1,
+        # same-bucket pairs count 1/2 (midrank)
+        above = np.cumsum(self.pos[::-1])[::-1] - self.pos
+        area = float((self.neg * (above + 0.5 * self.pos)).sum())
+        return float(ll), area / float(p_total * n_total)
