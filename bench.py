@@ -151,7 +151,8 @@ def run(step, state, batches, iters, warmup=3):
     def sync(st):
         # device_get forces real completion; block_until_ready has been
         # observed returning early on tunneled PJRT platforms
-        jax.device_get(st["tables"]["w"]["param"][:1, 0])
+        first = next(iter(st["tables"].values()))
+        jax.device_get(first["param"][:1, 0])
 
     for i in range(warmup):
         state, m = step.train(state, device_batches[i % len(device_batches)])

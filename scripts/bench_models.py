@@ -1,0 +1,94 @@
+"""Per-model training-step throughput (docs/PERF.md model-zoo table).
+
+Runs the fused train step for every model family at bench-scale shapes
+and prints one JSON line per model:
+    {"model": ..., "examples_per_sec": N, "batch_size": B, ...}
+
+Usage:  python scripts/bench_models.py [--cpu] [--batch-log2 N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")  # repo root
+
+from bench import build, make_batches, probe_accelerator  # noqa: E402
+
+
+def model_cfgs(base_b: int, accel: bool):
+    """(name, Config) per family.  FM/MVM: v_dim=10 (ftrl.h:16).  FFM:
+    Avazu-style 24 fields, D=4 (BASELINE.json target config).  Sizes
+    shrink on the CPU fallback to keep runtime bounded."""
+    from xflow_tpu.config import Config
+
+    t = 24 if accel else 20
+    b = base_b if accel else min(base_b, 16384)
+    common = dict(
+        optimizer="ftrl", table_size_log2=t, batch_size=b, num_devices=1
+    )
+    return [
+        ("lr", Config(model="lr", max_nnz=32, hot_size_log2=12,
+                      hot_nnz=16, **common)),
+        ("lr_nohot", Config(model="lr", max_nnz=40, **common)),
+        ("fm", Config(model="fm", max_nnz=40, v_dim=10, **common)),
+        ("mvm", Config(model="mvm", max_nnz=40, v_dim=10, max_fields=40,
+                       **common)),
+        ("ffm", Config(model="ffm", max_nnz=24, ffm_v_dim=4,
+                       max_fields=24, **common)),
+        ("wide_deep", Config(model="wide_deep", max_nnz=40, emb_dim=8,
+                             hidden_dim=64, **common)),
+    ]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--batch-log2", type=int, default=16)  # 65536
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    backend = None if args.cpu else probe_accelerator()
+    import jax
+
+    if backend is None:
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices("cpu")
+    else:
+        devices = [d for d in jax.devices() if d.platform != "cpu"]
+    accel = backend is not None
+    iters = args.iters if accel else max(2, args.iters // 3)
+
+    for name, cfg in model_cfgs(1 << args.batch_log2, accel):
+        try:
+            from bench import run
+
+            step, state = build(devices, cfg)
+            batches, _ = make_batches(cfg, 2)
+            t0 = time.time()
+            _, eps = run(step, state, batches, iters=iters, warmup=2)
+            print(
+                json.dumps(
+                    {
+                        "model": name,
+                        "examples_per_sec": round(eps, 1),
+                        "batch_size": cfg.batch_size,
+                        "table_size_log2": cfg.table_size_log2,
+                        "backend": backend or "cpu",
+                        "wall_s": round(time.time() - t0, 1),
+                    }
+                ),
+                flush=True,
+            )
+        except Exception as e:
+            print(
+                json.dumps({"model": name, "error": f"{type(e).__name__}: {e}"}),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

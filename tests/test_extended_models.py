@@ -141,3 +141,37 @@ def test_wide_deep_checkpoint_roundtrip(toy_dataset, tmp_path):
     assert t2.restore() is not None
     after = jax.device_get(t2.state["dense"])
     jax.tree.map(np.testing.assert_array_equal, before, after)
+
+
+def test_ffm_aggregated_matches_pairwise():
+    """The O(B*F^2*D) field-aggregated logit == the naive O(K^2) pairwise
+    definition, including invalid fields, padding, duplicate fields,
+    and values != 1."""
+    import jax
+    import jax.numpy as jnp
+
+    from xflow_tpu.models.ffm import FFMModel
+
+    rng = np.random.default_rng(5)
+    b, k, f, d, t = 17, 13, 6, 4, 256
+    model = FFMModel(v_dim=d, max_fields=f)
+    w = rng.normal(0, 1, (t, 1)).astype(np.float32)
+    v = rng.normal(0, 0.3, (t, f * d)).astype(np.float32)
+    keys = rng.integers(0, t, (b, k)).astype(np.int32)
+    batch = {
+        "keys": jnp.asarray(keys),
+        # includes out-of-range and negative fields, and duplicates
+        "slots": jnp.asarray(
+            rng.integers(-2, f + 3, (b, k)).astype(np.int32)
+        ),
+        "vals": jnp.asarray(rng.normal(0, 1, (b, k)).astype(np.float32)),
+        "mask": jnp.asarray(
+            (rng.random((b, k)) < 0.7).astype(np.float32)
+        ),
+        "labels": jnp.zeros(b, jnp.float32),
+        "weights": jnp.ones(b, jnp.float32),
+    }
+    rows = {"w": jnp.asarray(w)[keys], "v": jnp.asarray(v)[keys]}
+    fast = np.asarray(model.logit(rows, batch))
+    slow = np.asarray(model.logit_pairwise(rows, batch))
+    np.testing.assert_allclose(fast, slow, rtol=2e-4, atol=2e-5)

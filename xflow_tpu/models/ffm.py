@@ -13,9 +13,20 @@ max_fields contribute nothing (their one-hot row is zero), matching
 MVM's field handling.
 
 Pure autodiff model — no reference forward/backward quirks to
-reproduce.  The O(K^2) pair interaction is computed as a dense
-[B, K, K] einsum (MXU-friendly) with the diagonal and invalid pairs
-masked.
+reproduce.
+
+The pair interaction uses the field-aggregated identity (round-2
+restructure; the naive form materializes [B, K, K, D] pair tensors —
+tens of GB at bench shapes):
+
+    S[b, f1, f2, :] = sum_{i: field(i)=f1} x_i * v[k_i, f2, :]
+    sum_{i<j} <v[k_i,f_j], v[k_j,f_i]> x_i x_j
+        = 1/2 ( sum_{f1,f2} <S[f1,f2], S[f2,f1]>
+                - sum_i x_i^2 ||v[k_i, f_i]||^2 )
+
+which is O(B*K*F^2*D) compute via one MXU batch-matmul over K and
+O(B*F^2*D) memory — same-field pairs included, diagonal (i=i)
+subtracted, both orderings halved, exactly the i<j sum.
 """
 
 from __future__ import annotations
@@ -59,22 +70,59 @@ class FFMModel(AutodiffModel):
         linear = jnp.sum(rows["w"][..., 0] * x, axis=-1)
 
         v = rows["v"].reshape(b, k, f, d)  # per-key field-specific vectors
-        slot = jnp.clip(batch["slots"], 0, f - 1)  # [B, K]
         valid = (
             (batch["slots"] >= 0) & (batch["slots"] < f) & (batch["mask"] > 0)
         )  # [B, K] — negative field ids dropped, matching MVM/Wide&Deep
+        x_eff = jnp.where(valid, x, 0.0)
+        slot = jnp.clip(batch["slots"], 0, f - 1)  # [B, K]
+        # one-hot of each feature's own field; zero row for invalid
+        onehot = (
+            (slot[:, :, None] == jnp.arange(f)[None, None, :])
+            & valid[:, :, None]
+        ).astype(v.dtype)  # [B, K, F]
 
-        # v_for[b, i, j, :] = v[key_i, field_of_j, :] — gather i's latent
-        # vector specific to j's field, for every ordered pair (i, j).
+        # field-aggregated sums: S[b, f1, f2, :] — one batch matmul
+        # contracting K (MXU path), no [B, K, K, *] pair tensors
+        vx = v * x_eff[:, :, None, None]  # [B, K, F, D]
+        s = jnp.einsum("bkf,bkgd->bfgd", onehot, vx)  # [B, F, F, D]
+        cross = jnp.einsum("bfgd,bgfd->b", s, s)
+        # subtract the i == i diagonal: x_i^2 * ||v[k_i, f_i, :]||^2
+        v_self = jnp.take_along_axis(
+            v, slot[:, :, None, None].astype(jnp.int32), axis=2
+        )[:, :, 0, :]  # [B, K, D]
+        diag = jnp.sum(
+            jnp.sum(v_self * v_self, axis=-1) * x_eff * x_eff, axis=-1
+        )
+        return linear + 0.5 * (cross - diag)
+
+    def logit_pairwise(
+        self,
+        rows: dict[str, jax.Array],
+        batch: BatchArrays,
+        dense: dict | None = None,
+    ) -> jax.Array:
+        """Naive O(B*K^2*D) pairwise form — the definition the aggregated
+        ``logit`` must match (kept as the equivalence oracle for
+        tests/test_extended_models.py; do not use at scale)."""
+        b, k = batch["keys"].shape
+        f, d = self.max_fields, self.v_dim
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        linear = jnp.sum(rows["w"][..., 0] * x, axis=-1)
+
+        v = rows["v"].reshape(b, k, f, d)
+        slot = jnp.clip(batch["slots"], 0, f - 1)
+        valid = (
+            (batch["slots"] >= 0) & (batch["slots"] < f) & (batch["mask"] > 0)
+        )
+        # v_for[b, i, j, :] = v[key_i, field_of_j, :]
         v_for = v[
             jnp.arange(b)[:, None, None],
             jnp.arange(k)[None, :, None],
             slot[:, None, :],
             :,
         ]  # [B, K(i), K(j), D]
-
-        inter = jnp.einsum("bijd,bjid->bij", v_for, v_for)  # <v_i,fj , v_j,fi>
-        xx = x[:, :, None] * x[:, None, :]  # [B, K, K]
+        inter = jnp.einsum("bijd,bjid->bij", v_for, v_for)
+        xx = x[:, :, None] * x[:, None, :]
         pair_valid = (
             valid[:, :, None]
             & valid[:, None, :]

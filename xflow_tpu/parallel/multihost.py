@@ -1,0 +1,29 @@
+"""Multi-host exchange helpers.
+
+``multihost_utils.process_allgather`` routes values through jax.Arrays,
+and without ``jax_enable_x64`` JAX silently canonicalizes int64→int32
+and float64→float32 — corrupting byte offsets ≥ 2 GiB and float64
+metric accumulators.  ``allgather_exact`` ships the raw bytes as int32
+words instead, so any 4-byte-aligned dtype survives bit-exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def allgather_exact(arr: np.ndarray) -> np.ndarray:
+    """Allgather preserving dtype bit-exactly.
+
+    Returns ``[num_processes, *arr.shape]`` in ``arr``'s dtype.  The
+    itemsize must be a multiple of 4 (int32/float32/int64/float64...).
+    COLLECTIVE: every process must call with the same shape/dtype.
+    """
+    from jax.experimental import multihost_utils
+
+    a = np.ascontiguousarray(arr)
+    if a.ndim == 0:
+        a = a.reshape(1)
+    words = a.view(np.int32)
+    out = np.asarray(multihost_utils.process_allgather(words))
+    return out.view(a.dtype).reshape((-1, *arr.shape))

@@ -524,16 +524,17 @@ class Trainer:
             # .HistAuc) and only those reduce across hosts: O(buckets)
             # traffic/memory regardless of test-set size.  Logloss stays
             # exact; AUC uses midrank ties (see HistAuc docstring).
-            from jax.experimental import multihost_utils
-
+            from xflow_tpu.parallel.multihost import allgather_exact
             from xflow_tpu.utils.metrics import HistAuc
 
             hist = HistAuc()
             labels, pctr = acc.pairs()
             hist.add(labels, pctr)
-            gathered = multihost_utils.process_allgather(hist.state())
+            # bit-exact gather: the float64 histograms/sums must not be
+            # canonicalized to float32 (counts > 2^24 would drift)
             summed = {
-                k: np.asarray(v).sum(axis=0) for k, v in gathered.items()
+                k: allgather_exact(v).sum(axis=0)
+                for k, v in hist.state().items()
             }
             hist = HistAuc.from_state(summed)
             ll, auc = hist.compute()
@@ -559,12 +560,13 @@ class Trainer:
         # records every host's position; a host restores its own.
         cursors = [{"shard": int(shard_idx), "offset": int(offset)}]
         if self.num_hosts > 1:
-            from jax.experimental import multihost_utils
+            # allgather_exact: byte offsets are int64 (shards can exceed
+            # 2 GiB) and must not pass through JAX's 32-bit
+            # canonicalization
+            from xflow_tpu.parallel.multihost import allgather_exact
 
-            pairs = np.asarray(
-                multihost_utils.process_allgather(
-                    np.asarray([shard_idx, offset], np.int64)
-                )
+            pairs = allgather_exact(
+                np.asarray([shard_idx, offset], np.int64)
             ).reshape(self.num_hosts, 2)
             cursors = [
                 {"shard": int(s), "offset": int(o)} for s, o in pairs
