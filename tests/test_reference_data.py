@@ -1,0 +1,94 @@
+"""Pin training+eval on the reference's OWN bundled data — its de-facto
+verification procedure (SURVEY §4: smoke run over data/small_train-*
+through scripts/local.sh, eyeballing printed logloss/auc).  Round-1
+VERDICT: "Reference-bundled data is never exercised by CI" — this makes
+it permanent.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from xflow_tpu.config import Config
+from xflow_tpu.trainer import Trainer
+
+REF_DATA = "/root/reference/data"
+TRAIN = os.path.join(REF_DATA, "small_train")
+TEST = os.path.join(REF_DATA, "small_test")
+
+needs_ref = pytest.mark.skipif(
+    not os.path.exists(TRAIN + "-00000"), reason="reference data absent"
+)
+
+
+@needs_ref
+def test_reference_data_parses_fully():
+    """All 3x200 train lines and 200 test lines parse (libffm
+    label<TAB>fgid:fid:val, 18 fields/sample, data/small_train-00000:1)."""
+    from xflow_tpu.io.loader import ShardLoader, make_parse_fn
+    from xflow_tpu.trainer import find_shards
+
+    shards = find_shards(TRAIN)
+    assert len(shards) == 3
+    parse = make_parse_fn(1 << 16, True, 0)
+    max_nnz = 40  # data lines carry 15..36 features (NOT a fixed 18)
+    for path in shards + [TEST + "-00000"]:
+        loader = ShardLoader(
+            path, batch_size=64, max_nnz=max_nnz, table_size=1 << 16,
+            parse_fn=parse,
+        )
+        total = sum(b.num_real() for b, _ in loader.iter_batches())
+        assert total == 200
+        # every feature token of every line survives parsing (none
+        # dropped as malformed): expected count straight from the text
+        expect = sum(
+            min(len(line.split()) - 1, max_nnz)
+            for line in open(path, "rb")
+            if line.strip()
+        )
+        nnz = sum(
+            int((b.mask.sum(axis=1) * (b.weights > 0)).sum())
+            for b, _ in loader.iter_batches()
+        )
+        assert nnz == expect
+
+
+@needs_ref
+def test_reference_data_trains(tmp_path):
+    """LR+FTRL on the reference's data with its default hyperparameters
+    reaches finite, plausible metrics (independent 20-epoch anchor from
+    round-1 review: logloss 0.5416, AUC 0.554) and writes the
+    reference-granularity pred_<rank>_<block>.txt artifacts."""
+    pred_dir = str(tmp_path / "preds")
+    cfg = Config(
+        model="lr",
+        train_path=TRAIN,
+        test_path=TEST,
+        epochs=20,
+        batch_size=128,
+        table_size_log2=16,
+        max_nnz=24,
+        num_devices=1,
+        pred_out=pred_dir,
+        pred_style="per_block",
+    )
+    t = Trainer(cfg)
+    history = t.train()
+    assert history[-1]["examples"] == 600.0
+    result = t.evaluate()
+    assert np.isfinite(result["logloss"]) and np.isfinite(result["auc"])
+    assert result["examples"] == 200
+    # tp/fp are LABEL counts (reference base.h:101-108 prints positive/
+    # negative totals) — fixed by the data, not by model thresholds
+    assert result["tp"] == 46 and result["fp"] == 154
+    # deterministic run: metrics pinned to the round-1 independent anchor
+    assert abs(result["logloss"] - 0.5416) < 0.02
+    assert result["auc"] > 0.52
+    # reference artifact shape: pred_0_<block>.txt files totalling 200 lines
+    files = sorted(os.listdir(pred_dir))
+    assert files and all(f.startswith("pred_0_") for f in files)
+    lines = sum(
+        len(open(os.path.join(pred_dir, f)).readlines()) for f in files
+    )
+    assert lines == 200

@@ -92,9 +92,14 @@ class Config:
     profile_start_step: int = 10
 
     # -- eval / artifacts --
-    # Rank 0 dumps "(label, pctr)" prediction lines (reference:
-    # pred_<rank>_<block>.txt, lr_worker.cc:74-78).
+    # Prediction dump target.  With pred_style="single" (default) rank 0
+    # writes one file of "(label, pctr)" lines at pred_out —
+    # information-equivalent to the reference.  With
+    # pred_style="per_block", pred_out is a DIRECTORY and every host
+    # writes pred_<rank>_<block>.txt per eval batch, the reference's
+    # exact artifact granularity (lr_worker.cc:74-78).
     pred_out: str = ""
+    pred_style: str = "single"  # {"single", "per_block"}
     # Checkpoint directory ("" = checkpointing off). Capability gap filled:
     # the reference has no model save/load at all (SURVEY §5).
     checkpoint_dir: str = ""
@@ -175,6 +180,8 @@ class Config:
                 raise ValueError("hot_nnz must be > 0 when hot table is on")
         if self.hot_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown hot_dtype {self.hot_dtype!r}")
+        if self.pred_style not in ("single", "per_block"):
+            raise ValueError(f"unknown pred_style {self.pred_style!r}")
 
     @property
     def table_size(self) -> int:

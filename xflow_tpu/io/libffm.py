@@ -109,6 +109,14 @@ def parse_block(
                     continue
                 if not -(2**63) <= fid < 2**63:
                     continue  # keys are int64; reject, never wrap
+                # reject values not finite IN FLOAT32: inf/nan literals
+                # and "1e999"/"1e39"-style overflows the float32 cast
+                # would silently turn into inf (round-1 weak point 8).
+                # (2-2^-24)*2^127 is the exact round-to-nearest overflow
+                # boundary; `not <` also rejects nan.  Native parser
+                # matches exactly (parser.cc isfinite after narrowing).
+                if not abs(val) < 3.4028235677973366e38:
+                    continue
                 fids.append(fid)
                 vals.append(val)
             slots.append(fgid)

@@ -1,0 +1,99 @@
+// Standalone sanitizer harness for the native parser + packer.
+//
+// Built with -fsanitize=address,undefined by tests/test_native.py
+// (test_sanitizer_fuzz) and fed the fuzz corpus; any heap overflow,
+// OOB read, or UB aborts the process non-zero.  A standalone binary
+// (not the .so) so no LD_PRELOAD/asan-runtime gymnastics are needed.
+//
+// Usage: fuzz_driver FILE...   (each file = one raw parse block)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+int64_t xf_parse_block(const char* data, int64_t len, int64_t table_size,
+                       int hash_mode, uint64_t seed, float* labels,
+                       int64_t max_rows, int64_t* row_ptr, int64_t* keys,
+                       int32_t* slots, float* vals, int64_t max_nnz,
+                       int64_t* out_nnz);
+int64_t xf_pack_batch(const int64_t* row_ptr, const float* labels_in,
+                      const int64_t* keys_in, const int32_t* slots_in,
+                      const float* vals_in, int64_t start, int64_t end,
+                      int64_t batch_size, const int32_t* remap,
+                      int64_t hot_size, int64_t hot_nnz, int64_t cold_nnz,
+                      int32_t* keys, int32_t* slots, float* vals, float* mask,
+                      int32_t* hot_keys, int32_t* hot_slots, float* hot_vals,
+                      float* hot_mask, float* labels, float* weights);
+}
+
+namespace {
+
+void drive(const std::string& data, int hash_mode) {
+  constexpr int64_t kTable = 1 << 12;
+  // capacity bounds mirror ffi.py: lines <= '\n' count + 1, features
+  // have exactly 2 ':' bytes each
+  int64_t max_rows = std::count(data.begin(), data.end(), '\n') + 1;
+  int64_t max_nnz = std::count(data.begin(), data.end(), ':') / 2 + 1;
+  std::vector<float> labels(max_rows);
+  std::vector<int64_t> row_ptr(max_rows + 1);
+  std::vector<int64_t> keys(max_nnz);
+  std::vector<int32_t> slots(max_nnz);
+  std::vector<float> vals(max_nnz);
+  int64_t nnz = 0;
+  int64_t n = xf_parse_block(data.data(), data.size(), kTable, hash_mode,
+                             /*seed=*/7, labels.data(), max_rows,
+                             row_ptr.data(), keys.data(), slots.data(),
+                             vals.data(), max_nnz, &nnz);
+  if (n < 0) {
+    std::fprintf(stderr, "capacity overflow (bound bug)\n");
+    std::exit(2);
+  }
+  // pack every prefix/suffix window through hot and non-hot paths
+  std::vector<int32_t> remap(kTable);
+  for (int64_t i = 0; i < kTable; ++i)
+    remap[i] = static_cast<int32_t>(kTable - 1 - i);
+  const int64_t bs = 16, cold = 5, hot_nnz = 3, hot_size = 64;
+  std::vector<int32_t> bkeys(bs * cold), bslots(bs * cold);
+  std::vector<float> bvals(bs * cold), bmask(bs * cold);
+  std::vector<int32_t> hkeys(bs * hot_nnz), hslots(bs * hot_nnz);
+  std::vector<float> hvals(bs * hot_nnz), hmask(bs * hot_nnz);
+  std::vector<float> blabels(bs), bweights(bs);
+  for (int64_t s = 0; s < n; s += bs) {
+    int64_t e = std::min(n, s + bs);
+    xf_pack_batch(row_ptr.data(), labels.data(), keys.data(), slots.data(),
+                  vals.data(), s, e, bs, nullptr, 0, 0, cold, bkeys.data(),
+                  bslots.data(), bvals.data(), bmask.data(), nullptr, nullptr,
+                  nullptr, nullptr, blabels.data(), bweights.data());
+    xf_pack_batch(row_ptr.data(), labels.data(), keys.data(), slots.data(),
+                  vals.data(), s, e, bs, remap.data(), hot_size, hot_nnz,
+                  cold, bkeys.data(), bslots.data(), bvals.data(),
+                  bmask.data(), hkeys.data(), hslots.data(), hvals.data(),
+                  hmask.data(), blabels.data(), bweights.data());
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  for (int i = 1; i < argc; ++i) {
+    std::FILE* f = std::fopen(argv[i], "rb");
+    if (f == nullptr) {
+      std::fprintf(stderr, "cannot open %s\n", argv[i]);
+      return 1;
+    }
+    std::string data;
+    char buf[1 << 16];
+    size_t got;
+    while ((got = std::fread(buf, 1, sizeof(buf), f)) > 0)
+      data.append(buf, got);
+    std::fclose(f);
+    drive(data, /*hash_mode=*/1);
+    drive(data, /*hash_mode=*/0);
+  }
+  return 0;
+}

@@ -22,6 +22,7 @@
 //   * keys reduced modulo table_size
 
 #include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -207,7 +208,11 @@ int64_t xf_parse_block(const char* data, int64_t len, int64_t table_size,
               int64_t fid;
               float val;
               if (parse_int_full(c1 + 1, c2, &fid) &&
-                  parse_float_full(c2 + 1, t_end, &val)) {
+                  parse_float_full(c2 + 1, t_end, &val) &&
+                  // reject values not finite in float32 (inf/nan
+                  // literals and 1e39/1e999-style overflows) — matches
+                  // libffm.py's finite-in-float32 rule exactly
+                  std::isfinite(val)) {
                 if (nnz == max_nnz) return -1;
                 int64_t k = fid % table_size;
                 if (k < 0) k += table_size;

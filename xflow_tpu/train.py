@@ -72,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--hot-dtype", choices=["float32", "bfloat16"], dest="hot_dtype"
     )
     p.add_argument("--pred-out", dest="pred_out")
+    p.add_argument(
+        "--pred-style", choices=["single", "per_block"], dest="pred_style",
+        help="'per_block': pred_out is a directory; every host writes "
+        "pred_<rank>_<block>.txt per eval batch (reference artifact "
+        "granularity, lr_worker.cc:74-78)",
+    )
     p.add_argument("--metrics-out", dest="metrics_out")
     p.add_argument("--profile-dir", dest="profile_dir")
     p.add_argument("--profile-steps", type=int, dest="profile_steps")

@@ -17,6 +17,7 @@ reference's pred_<rank>_<block>.txt, lr_worker.cc:74-78).
 from __future__ import annotations
 
 import glob
+import os
 import sys
 import time
 from typing import Callable, Iterator
@@ -45,8 +46,6 @@ def find_shards(prefix: str) -> list[str]:
     treat ``prefix`` itself as a single file."""
     shards = sorted(glob.glob(glob.escape(prefix) + "-" + "[0-9]" * 5))
     if not shards:
-        import os
-
         if os.path.exists(prefix):
             return [prefix]
         raise FileNotFoundError(f"no shards matching {prefix}-NNNNN and no file {prefix}")
@@ -104,8 +103,6 @@ class Trainer:
             # permuted space; resuming it hot-off would read wrong rows
             path = self._remap_path()
             if path is not None:
-                import os
-
                 if os.path.exists(path):
                     raise ValueError(
                         f"{path} exists: this checkpoint_dir was trained "
@@ -116,8 +113,6 @@ class Trainer:
     def _remap_path(self) -> str | None:
         if not self.cfg.checkpoint_dir:
             return None
-        import os
-
         return os.path.join(self.cfg.checkpoint_dir, "remap.npy")
 
     def _init_remap(self) -> None:
@@ -166,8 +161,6 @@ class Trainer:
             f"sampled feature occurrences"
         )
         if path is not None and self.host == 0:
-            import os
-
             os.makedirs(cfg.checkpoint_dir, exist_ok=True)
             freq.save_remap(path, self.remap)
 
@@ -201,8 +194,6 @@ class Trainer:
     def _parse_workers(self) -> int:
         w = self.cfg.parse_workers
         if w < 0:
-            import os
-
             w = max(1, min(6, (os.cpu_count() or 1) - 1))
         return w
 
@@ -480,7 +471,17 @@ class Trainer:
         acc = AucAccumulator()
         pred_file = None
         out_path = pred_out if pred_out is not None else cfg.pred_out
-        if out_path and self.host == 0:
+        per_block = bool(out_path) and cfg.pred_style == "per_block"
+        if per_block:
+            os.makedirs(out_path, exist_ok=True)
+            # clear THIS host's stale artifacts: a previous eval with
+            # more blocks would otherwise leave old pred files mixed
+            # into the new set ('single' mode truncates on open)
+            for f in glob.glob(
+                os.path.join(out_path, f"pred_{self.host}_*.txt")
+            ):
+                os.remove(f)
+        elif out_path and self.host == 0:
             pred_file = open(out_path, "w")
         def batches() -> Iterator[tuple[Batch, int, int]]:
             workers = self._parse_workers()
@@ -495,6 +496,7 @@ class Trainer:
 
         try:
             # predict is collective too — keep hosts step-aligned
+            block_idx = 0
             for batch, _, _ in self._synced_batches(batches()):
                 arrays = self.step.put_batch(batch)
                 garr = self.step.predict(self.state, arrays)
@@ -508,7 +510,22 @@ class Trainer:
                     )
                 pctr = np.asarray(jax.device_get(garr))
                 acc.add(batch.labels, pctr, batch.weights)
-                if pred_file is not None:
+                if per_block and batch.weights.sum() > 0:
+                    # reference artifact granularity: one
+                    # pred_<rank>_<block>.txt per worker per block
+                    # (lr_worker.cc:74-78); padding batches (multi-host
+                    # step alignment) produce no file
+                    with open(
+                        os.path.join(
+                            out_path, f"pred_{self.host}_{block_idx}.txt"
+                        ),
+                        "w",
+                    ) as f:
+                        for y, p, w in zip(batch.labels, pctr, batch.weights):
+                            if w > 0:
+                                f.write(f"{int(y)}\t{p:.6f}\n")
+                    block_idx += 1
+                elif pred_file is not None:
                     for y, p, w in zip(batch.labels, pctr, batch.weights):
                         if w > 0:
                             # "(label, pctr)" lines, lr_worker.cc:62-68.
