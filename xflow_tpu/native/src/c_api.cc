@@ -9,10 +9,13 @@
 // four functions.  Configuration beyond the two paths is passed as a
 // JSON object string matching xflow_tpu.config.Config fields.
 //
-// Thread-model: all calls must come from one thread (the embedded
-// interpreter is initialized lazily on first XFCreate).  Errors return
-// NULL/-1; XFLastError() returns a static description of the most
-// recent failure.
+// Thread-model: the interpreter is initialized lazily on first
+// XFCreate (and the GIL released immediately after), so the library
+// also works inside a host process that ALREADY embeds Python.  Every
+// API body acquires the GIL via PyGILState_Ensure, so calls may come
+// from any thread; concurrent calls serialize on the GIL.  Errors
+// return NULL/-1; XFLastError() returns a description of the most
+// recent failure (read it from the thread that observed the error).
 
 #include <Python.h>
 
@@ -21,6 +24,19 @@
 namespace {
 
 std::string g_last_error;
+
+// RAII GIL acquisition: correct both when this library initialized
+// Python (we released the GIL after init) and when the host app did.
+class GilGuard {
+ public:
+  GilGuard() : state_(PyGILState_Ensure()) {}
+  ~GilGuard() { PyGILState_Release(state_); }
+  GilGuard(const GilGuard&) = delete;
+  GilGuard& operator=(const GilGuard&) = delete;
+
+ private:
+  PyGILState_STATE state_;
+};
 
 void capture_py_error() {
   PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
@@ -43,7 +59,12 @@ void capture_py_error() {
 bool ensure_python() {
   if (Py_IsInitialized() != 0) return true;
   Py_InitializeEx(0);  // no signal handlers: the host app owns them
-  return Py_IsInitialized() != 0;
+  if (Py_IsInitialized() == 0) return false;
+  // Py_InitializeEx leaves this thread holding the GIL; release it so
+  // every API body (any thread, including this one) can acquire it
+  // symmetrically through PyGILState_Ensure.
+  PyEval_SaveThread();
+  return true;
 }
 
 // Call xflow_tpu.capi_impl.<fn>(args...); returns a new reference or
@@ -82,6 +103,7 @@ XFHandle XFCreate(const char* train_path, const char* test_path,
     g_last_error = "failed to initialize embedded python";
     return nullptr;
   }
+  GilGuard gil;
   PyObject* args = Py_BuildValue(
       "(sss)", train_path != nullptr ? train_path : "",
       test_path != nullptr ? test_path : "",
@@ -96,7 +118,8 @@ XFHandle XFCreate(const char* train_path, const char* test_path,
 }
 
 int XFStartTrain(XFHandle h) {
-  if (h == nullptr) return -1;
+  if (h == nullptr || Py_IsInitialized() == 0) return -1;
+  GilGuard gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
   if (args == nullptr) {
     capture_py_error();
@@ -110,7 +133,8 @@ int XFStartTrain(XFHandle h) {
 }
 
 int XFEvaluate(XFHandle h, double* logloss, double* auc) {
-  if (h == nullptr) return -1;
+  if (h == nullptr || Py_IsInitialized() == 0) return -1;
+  GilGuard gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
   if (args == nullptr) {
     capture_py_error();
@@ -132,7 +156,9 @@ int XFEvaluate(XFHandle h, double* logloss, double* auc) {
 }
 
 void XFDestroy(XFHandle h) {
-  if (h != nullptr) Py_DECREF(static_cast<PyObject*>(h));
+  if (h == nullptr || Py_IsInitialized() == 0) return;
+  GilGuard gil;
+  Py_DECREF(static_cast<PyObject*>(h));
 }
 
 }  // extern "C"
