@@ -84,6 +84,43 @@ def test_latest_checkpoint_empty(tmp_path):
     assert latest_checkpoint(str(tmp_path)) is None
 
 
+def test_checkpoint_keep_last_k(toy_dataset, tmp_path):
+    """checkpoint_keep=2: only the 2 newest ckpt-* dirs survive a run
+    that writes one checkpoint per epoch (unbounded accumulation at
+    2^28-row FM scale is ~13 GB per checkpoint)."""
+    import glob
+    import os
+
+    t = Trainer(cfg_for(toy_dataset, tmp_path, epochs=4, checkpoint_keep=2))
+    t.train()
+    ckpts = sorted(glob.glob(str(tmp_path / "ckpt-*")))
+    assert len(ckpts) == 2
+    # the survivors are the NEWEST two, and LATEST points at the newest
+    steps = [int(os.path.basename(c).split("-")[1]) for c in ckpts]
+    assert steps == sorted(steps)
+    with open(tmp_path / "LATEST") as f:
+        assert f.read().strip() == os.path.basename(ckpts[-1])
+    # restore still works from the retained set
+    t2 = Trainer(cfg_for(toy_dataset, tmp_path, epochs=4, checkpoint_keep=2))
+    cursor = t2.restore()
+    assert cursor is not None and cursor["epoch"] == 4
+
+
+def test_save_failure_raises_not_hangs(toy_dataset, tmp_path):
+    """A checkpoint-dir that cannot be created must surface as an
+    exception from save() (single-host analogue of the multi-host
+    pre-barrier protocol test in test_distributed.py)."""
+    import pytest
+
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a regular file where the ckpt dir should go")
+    cfg = cfg_for(toy_dataset, tmp_path, epochs=1)
+    cfg = cfg.replace(checkpoint_dir=str(blocker / "ck"))
+    t = Trainer(cfg)
+    with pytest.raises(OSError):
+        t.save()
+
+
 def test_mid_epoch_cursor_used_on_resume(toy_dataset, tmp_path, monkeypatch):
     """A mid-epoch checkpoint's (shard, offset) cursor must flow into the
     first train_epoch after restore (not restart the epoch from zero)."""

@@ -98,6 +98,63 @@ def test_two_process_training(toy_dataset, tmp_path, hot):
         assert "resumed at" in errs[0]
 
 
+def test_two_process_ckpt_mkdir_failure_raises_not_hangs(toy_dataset, tmp_path):
+    """Round-2 advisor finding: an exception on process 0 BEFORE the
+    post-mkdir synchronization point (e.g. os.makedirs failing) used to
+    send process 0 into _all_ok's allgather while process 1 sat in a
+    bare sync_global_devices — mismatched collectives, multi-host hang.
+    With the mkdir outcome itself voted through _all_ok, both processes
+    must now exit nonzero promptly instead of deadlocking."""
+    port = _free_port()
+    env_base = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+    )
+    blocker = tmp_path / "blocker"
+    blocker.write_text("regular file: makedirs(blocker/ck) must fail")
+    cmd = [
+        sys.executable, "-m", "xflow_tpu.train",
+        "--model", "lr",
+        "--train", toy_dataset.train_prefix,
+        "--test", toy_dataset.test_prefix,
+        "--epochs", "1",
+        "--batch-size", "64",
+        "--table-size-log2", "14",
+        "--max-nnz", "24",
+        "--num-devices", "2",
+        "--platform", "cpu",
+        "--coordinator", f"localhost:{port}",
+        "--num-processes", "2",
+        "--checkpoint-dir", str(blocker / "ck"),
+        "--skip-eval",
+    ]
+    procs = [
+        subprocess.Popen(
+            cmd + ["--process-id", str(pid)],
+            env=env_base, stderr=subprocess.PIPE, text=True,
+            cwd=os.getcwd(),
+        )
+        for pid in range(2)
+    ]
+    errs = []
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(
+                "checkpoint mkdir failure deadlocked the job (pre-barrier "
+                "exception not voted through _all_ok?)"
+            )
+        errs.append(err)
+    assert procs[0].returncode != 0, "process 0 should fail on mkdir"
+    assert procs[1].returncode != 0, "process 1 should learn of the failure"
+    assert "NotADirectoryError" in errs[0] or "FileExistsError" in errs[0]
+    assert "checkpoint mkdir failed on process 0" in errs[1]
+
+
 def test_two_process_midepoch_cursor_resume(toy_dataset, tmp_path):
     """Mid-epoch checkpoints record EVERY host's (shard, offset) cursor
     and each host resumes from its own — the round-1 advisor finding:

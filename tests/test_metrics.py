@@ -102,14 +102,21 @@ def test_hist_auc_matches_exact():
 
 
 def test_auc_tie_semantics_bounds():
-    """Tie-heavy golden test (VERDICT round 1).  The reference's AUC
-    under tied pctrs depends on std::sort's arbitrary permutation
-    (base.h:89-106: each negative counts positives EARLIER in sort
-    order, so within a tied group the area can be anything between 0 and
-    p_g*n_g extra).  Contract: our exact accumulator must land inside
-    the reference's achievable [min, max] envelope, and the histogram
-    path must sit exactly at the midpoint (midrank)."""
-    from xflow_tpu.utils.metrics import AucAccumulator, HistAuc, auc_rank_sum
+    """Tie-heavy golden test (VERDICT round 1, tightened round 4).  The
+    reference's AUC under tied pctrs depends on std::sort's arbitrary
+    permutation (base.h:89-106: each negative counts positives EARLIER
+    in sort order, so within a tied group the area can be anything
+    between 0 and p_g*n_g extra).  Contract: the reference-parity
+    ``auc_rank_sum`` lands inside that achievable [min, max] envelope,
+    while BOTH reporting paths — exact (auc_midrank, used by
+    AucAccumulator) and histogram (HistAuc) — sit exactly at the
+    midpoint (midrank), independent of host count."""
+    from xflow_tpu.utils.metrics import (
+        AucAccumulator,
+        HistAuc,
+        auc_midrank,
+        auc_rank_sum,
+    )
 
     rng = np.random.default_rng(11)
     # 5 distinct pctr levels, 400 samples each -> massive tie groups
@@ -136,7 +143,17 @@ def test_auc_tie_semantics_bounds():
 
     got = auc_rank_sum(labels, pctr)
     assert lo - 1e-12 <= got <= hi + 1e-12
+    # both reporting paths: exactly the midrank midpoint
+    np.testing.assert_allclose(
+        auc_midrank(labels, pctr), (lo + hi) / 2, rtol=1e-12
+    )
+    acc = AucAccumulator()
+    acc.add(labels, pctr)
+    _, auc_acc = acc.compute()
+    np.testing.assert_allclose(auc_acc, (lo + hi) / 2, rtol=1e-12)
     hist = HistAuc()
     hist.add(labels, pctr)
     _, auc_h = hist.compute()
     np.testing.assert_allclose(auc_h, (lo + hi) / 2, rtol=1e-12)
+    # single-host (exact midrank) ≡ multi-host (histogram midrank)
+    np.testing.assert_allclose(auc_acc, auc_h, rtol=1e-12)

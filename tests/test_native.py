@@ -254,6 +254,44 @@ def test_native_pack_parity(hot, use_remap):
                 )
 
 
+def test_native_key_range_guards():
+    """Round-2 advisor finding: the native entry points are callable
+    directly (bypassing Config's table_size_log2 <= 30 guard), and the
+    pack path narrows int64 keys to int32 — both must reject rather
+    than silently wrap."""
+    from xflow_tpu.io.batch import ParsedBlock
+
+    # parse: table_size beyond 2^31 would emit keys that can't survive
+    # the downstream int32 batch cast
+    with pytest.raises(ValueError, match="table_size"):
+        native.native_parse_block(b"1\t0:5:1\n", 1 << 32)
+    with pytest.raises(ValueError, match="table_size"):
+        native.native_parse_block(b"1\t0:5:1\n", 0)
+
+    # pack: a raw key outside int32 (e.g. from a direct caller's own
+    # CSR block) must raise, not wrap
+    block = ParsedBlock(
+        labels=np.asarray([1.0], np.float32),
+        row_ptr=np.asarray([0, 1], np.int64),
+        keys=np.asarray([1 << 33], np.int64),
+        slots=np.asarray([0], np.int32),
+        vals=np.asarray([1.0], np.float32),
+    )
+    with pytest.raises(ValueError, match="int32"):
+        native.native_pack_batch(block, 0, 1, 4, 4)
+
+    # boundary: INT32_MAX itself still packs
+    block_ok = ParsedBlock(
+        labels=np.asarray([1.0], np.float32),
+        row_ptr=np.asarray([0, 1], np.int64),
+        keys=np.asarray([(1 << 31) - 1], np.int64),
+        slots=np.asarray([0], np.int32),
+        vals=np.asarray([1.0], np.float32),
+    )
+    got = native.native_pack_batch(block_ok, 0, 1, 4, 4)
+    assert got.keys[0, 0] == (1 << 31) - 1
+
+
 def test_loader_full_batches_across_blocks(tmp_path):
     """Batches span text-block boundaries: only the final batch of a
     shard is partial, regardless of block size."""

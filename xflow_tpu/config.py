@@ -100,10 +100,18 @@ class Config:
     # exact artifact granularity (lr_worker.cc:74-78).
     pred_out: str = ""
     pred_style: str = "single"  # {"single", "per_block"}
+    # Evaluate on test_path every N epochs during training (0 = only the
+    # final eval after all epochs, the reference's behavior —
+    # lr_worker.cc:212-215).  Convergence curves (BASELINE.md) use this.
+    eval_every_epochs: int = 0
     # Checkpoint directory ("" = checkpointing off). Capability gap filled:
     # the reference has no model save/load at all (SURVEY §5).
     checkpoint_dir: str = ""
     checkpoint_every_steps: int = 0  # 0 = only at epoch ends
+    # Keep only the newest K ckpt-* dirs (0 = keep all).  At north-star
+    # scale a single FM checkpoint is ~13 GB (2^28 rows x (1+10) cols x
+    # 3 arrays x 4 B), so unbounded accumulation fills the disk fast.
+    checkpoint_keep: int = 0
 
     # -- host data path --
     # Use the native C++ parser (xflow_tpu/native) when a toolchain is

@@ -191,13 +191,10 @@ class ShardLoader:
                         yield fut.result(), off, noff
 
             carry: ParsedBlock | None = None
-            carry_offset = start_offset
             end_offset = start_offset
             for block, raw_offset, next_offset in parsed_blocks():
                 end_offset = next_offset
-                n_carry = 0
                 if carry is not None and carry.num_samples:
-                    n_carry = carry.num_samples
                     block = _concat_blocks(carry, block)
                 carry = None
                 n = block.num_samples
@@ -205,23 +202,15 @@ class ShardLoader:
                 while n - start >= self.batch_size:
                     end = start + self.batch_size
                     # resume = earliest block holding a not-yet-yielded
-                    # sample: past the carry it's this raw block, else the
-                    # block the carry came from; end == n consumed it all
-                    if end == n:
-                        resume = next_offset
-                    elif end >= n_carry:
-                        resume = raw_offset
-                    else:
-                        resume = carry_offset
+                    # sample.  The carry is always < batch_size samples,
+                    # so the first batch of this loop consumes it whole:
+                    # unyielded samples start in this raw block (or past
+                    # it entirely when end == n).
+                    resume = next_offset if end == n else raw_offset
                     yield self._pack(block, start, end), resume
                     start = end
                 if start < n:
                     carry = _slice_block(block, start)
-                    if start >= n_carry:
-                        # remainder lies entirely in this raw block
-                        carry_offset = raw_offset
-                    # else: keep the old carry_offset (remainder still
-                    # includes samples from the earlier block)
             if carry is not None and carry.num_samples:
                 # the stream's final (partial) batch consumes everything
                 yield self._pack(carry, 0, carry.num_samples), end_offset

@@ -114,6 +114,14 @@ def native_parse_block(
     tests/test_native.py)."""
     lib = load_library()
     assert lib is not None, "native library unavailable"
+    # Keys must survive the downstream int32 batch cast (xf_pack_batch);
+    # Config guards table_size_log2 <= 30 on the CLI path, but this
+    # entry point is callable directly (round-2 advisor finding).
+    if not 0 < table_size <= (1 << 31):
+        raise ValueError(
+            f"table_size {table_size} out of range (0, 2^31] — parsed "
+            "keys must fit int32 batch arrays"
+        )
     # capacity bounds: every sample has one line; every feature token has
     # exactly 2 of the block's ':' bytes
     max_rows = data.count(b"\n") + 1
@@ -190,7 +198,7 @@ def native_pack_batch(
     labels = np.empty(batch_size, np.float32)
     weights = np.empty(batch_size, np.float32)
     null_i32 = ctypes.POINTER(ctypes.c_int32)()
-    lib.xf_pack_batch(
+    rc = lib.xf_pack_batch(
         _ptr(row_ptr, ctypes.c_int64),
         _ptr(labels_in, ctypes.c_float),
         _ptr(keys_in, ctypes.c_int64),
@@ -214,6 +222,13 @@ def native_pack_batch(
         _ptr(labels, ctypes.c_float),
         _ptr(weights, ctypes.c_float),
     )
+    if rc == -2:
+        raise ValueError(
+            "pack_batch: a (remapped) key exceeds int32 — table_size or "
+            "remap values too large for the int32 batch arrays"
+        )
+    if rc < 0:
+        raise RuntimeError(f"native pack_batch failed (rc={rc})")
     if not kh:
         return Batch(
             keys=keys, slots=slots, vals=vals, mask=mask,

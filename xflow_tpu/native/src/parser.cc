@@ -251,6 +251,11 @@ int64_t xf_parse_block(const char* data, int64_t len, int64_t table_size,
 //     >= end-start) are fully zero with weight 0.
 // Outputs may be uninitialized (np.empty): every slot is written.
 // hot_* pointers may be null when hot_nnz == 0.  remap may be null.
+//
+// Returns -2 if any (remapped) key falls outside int32 — the batch
+// arrays are int32, and Config's table_size_log2 <= 30 guard only
+// covers the CLI path; this entry point is callable directly, so the
+// narrowing cast must be checked here, not assumed.
 int64_t xf_pack_batch(const int64_t* row_ptr, const float* labels_in,
                       const int64_t* keys_in, const int32_t* slots_in,
                       const float* vals_in, int64_t start, int64_t end,
@@ -277,6 +282,7 @@ int64_t xf_pack_batch(const int64_t* row_ptr, const float* labels_in,
       for (int64_t e = lo; e < hi; ++e) {
         int64_t k = keys_in[e];
         if (remap != nullptr) k = remap[k];
+        if (k < 0 || k > INT32_MAX) return -2;  // would wrap in int32 cast
         if (k < hot_size && hot < hot_nnz) {
           hot_keys[i * hot_nnz + hot] = static_cast<int32_t>(k);
           hot_slots[i * hot_nnz + hot] = slots_in[e];

@@ -86,6 +86,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--checkpoint-every-steps", type=int, dest="checkpoint_every_steps"
     )
+    p.add_argument(
+        "--checkpoint-keep", type=int, dest="checkpoint_keep",
+        help="keep only the newest K checkpoints (0 = keep all)",
+    )
+    p.add_argument(
+        "--eval-every", type=int, dest="eval_every_epochs",
+        help="run evaluation every N epochs during training (0 = only "
+        "at the end) — the reference evaluates once, after all epochs "
+        "(lr_worker.cc:212-215)",
+    )
     p.add_argument("--resume", action="store_true", help="resume from latest checkpoint")
     p.add_argument(
         "--platform",

@@ -425,6 +425,13 @@ class Trainer:
                 self.epoch += 1
                 if self.cfg.checkpoint_dir:
                     self.save(0, 0)
+                if (
+                    self.cfg.eval_every_epochs
+                    and self.cfg.test_path
+                    and self.epoch < self.cfg.epochs  # final eval is the caller's
+                    and self.epoch % self.cfg.eval_every_epochs == 0
+                ):
+                    self.evaluate()
         finally:
             restore_handlers()
         return history
@@ -540,7 +547,10 @@ class Trainer:
             # folds its pairs into fixed-size histograms (utils.metrics
             # .HistAuc) and only those reduce across hosts: O(buckets)
             # traffic/memory regardless of test-set size.  Logloss stays
-            # exact; AUC uses midrank ties (see HistAuc docstring).
+            # exact; AUC uses midrank ties on BOTH the single- and
+            # multi-host paths (AucAccumulator.compute is auc_midrank),
+            # so host count never changes the reported AUC beyond
+            # histogram quantization (< 1e-6 bucket width).
             from xflow_tpu.parallel.multihost import allgather_exact
             from xflow_tpu.utils.metrics import HistAuc
 
@@ -561,7 +571,14 @@ class Trainer:
             ll, auc = acc.compute()
             n = acc.count()
             pos = int(acc.pairs()[0].sum()) if n else 0
-        result = {"logloss": ll, "auc": auc, "examples": n, "tp": pos, "fp": n - pos}
+        result = {
+            "epoch": self.epoch,
+            "logloss": ll,
+            "auc": auc,
+            "examples": n,
+            "tp": pos,
+            "fp": n - pos,
+        }
         self._log(f"logloss: {ll:.6f}\tauc = {auc:.6f}\ttp = {pos} fp = {n - pos}")
         if self.metrics_logger is not None:
             self.metrics_logger.log("eval", result)
@@ -597,7 +614,11 @@ class Trainer:
             "offset": cursors[0]["offset"],
         }
         return save_checkpoint(
-            self.cfg.checkpoint_dir, self.state, cursor, self.cfg.to_json()
+            self.cfg.checkpoint_dir,
+            self.state,
+            cursor,
+            self.cfg.to_json(),
+            keep=self.cfg.checkpoint_keep,
         )
 
     def restore(self) -> dict | None:

@@ -1,8 +1,15 @@
 from xflow_tpu.utils.metrics import (
     sigmoid_ref,
     logloss,
+    auc_midrank,
     auc_rank_sum,
     AucAccumulator,
 )
 
-__all__ = ["sigmoid_ref", "logloss", "auc_rank_sum", "AucAccumulator"]
+__all__ = [
+    "sigmoid_ref",
+    "logloss",
+    "auc_midrank",
+    "auc_rank_sum",
+    "AucAccumulator",
+]

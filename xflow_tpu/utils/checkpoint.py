@@ -39,13 +39,6 @@ MANIFEST = "manifest.json"
 _RANGE_RE = re.compile(r"\.r(\d+)-(\d+)\.npy$")
 
 
-def _barrier(name: str) -> None:
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices(name)
-
-
 def _all_ok(local_ok: bool) -> bool:
     """True iff every process reports success.  Doubles as a barrier, so
     a process that FAILED its local I/O still reaches this point and the
@@ -105,10 +98,13 @@ def save_checkpoint(
     state: dict[str, Any],
     cursor: dict[str, Any],
     config_json: str | None = None,
+    keep: int = 0,
 ) -> str:
     """Write one checkpoint; returns its path.  ``state`` is the train
     step's pytree; ``cursor`` is loader-position metadata — pass
     per-host cursors under ``cursor["cursors"]`` (trainer.save does).
+    ``keep`` > 0 deletes all but the newest ``keep`` ckpt-* dirs after a
+    successful save (0 = keep everything).
 
     Multi-host: COLLECTIVE — all processes call together; each writes
     its own shards (see module docstring)."""
@@ -116,9 +112,12 @@ def save_checkpoint(
     final = os.path.join(directory, f"ckpt-{step:010d}")
     tmp = os.path.join(directory, f".tmp-ckpt-{step:010d}")
     proc = jax.process_index()
-    # Every process passes through BOTH _all_ok gates on every path, so
-    # a local I/O failure is reported to the peers instead of leaving
-    # them deadlocked in a bare barrier.
+    # Every process passes through ALL THREE _all_ok gates on every
+    # path, so a local I/O failure at any stage — including process 0's
+    # mkdir, which runs before any peer has work to do — is reported to
+    # the peers instead of leaving them deadlocked (a bare barrier here
+    # would hang: the failing process would enter _all_ok's allgather
+    # while the others sit in sync_global_devices).
     err: BaseException | None = None
     try:
         if proc == 0:
@@ -126,7 +125,15 @@ def save_checkpoint(
             if os.path.exists(tmp):  # leftover from a crashed attempt
                 shutil.rmtree(tmp)
             os.makedirs(tmp)
-        _barrier(f"ckpt-mkdir-{step}")
+    except BaseException as e:
+        err = e
+    if not _all_ok(err is None):
+        if err is not None:
+            raise err
+        raise RuntimeError(
+            f"checkpoint mkdir failed on process 0 (step {step})"
+        )
+    try:
         arrays_meta: dict[str, Any] = {}
         for key, arr in _flat_arrays(state):
             arrays_meta[key] = {
@@ -171,6 +178,8 @@ def save_checkpoint(
                 shutil.rmtree(final)
             os.rename(tmp, final)
             _write_latest(directory, os.path.basename(final))
+            if keep > 0:
+                gc_checkpoints(directory, keep)
     except BaseException as e:
         err = e
     if not _all_ok(err is None):
@@ -182,6 +191,33 @@ def save_checkpoint(
             f"checkpoint finalize failed on process 0 (step {step})"
         )
     return final
+
+
+def gc_checkpoints(directory: str, keep: int) -> list[str]:
+    """Delete all but the newest ``keep`` ckpt-* dirs (by step number —
+    the zero-padded name sorts chronologically); returns the deleted
+    paths.  The dir LATEST points at is never deleted even if a clock
+    anomaly makes it sort old.  Process-0-only in multi-host runs
+    (save_checkpoint calls it inside the rank-0 finalize block)."""
+    assert keep > 0
+    cands = sorted(
+        d
+        for d in os.listdir(directory)
+        if d.startswith("ckpt-")
+        and os.path.isdir(os.path.join(directory, d))
+    )
+    latest = None
+    marker = os.path.join(directory, "LATEST")
+    if os.path.exists(marker):
+        with open(marker) as f:
+            latest = f.read().strip()
+    doomed = [d for d in cands[:-keep] if d != latest]
+    removed = []
+    for d in doomed:
+        path = os.path.join(directory, d)
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    return removed
 
 
 def _write_latest(directory: str, name: str) -> None:

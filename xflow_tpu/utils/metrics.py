@@ -6,7 +6,15 @@
   (base.h:84-110): sort by pctr descending; walking down, count
   positives seen (tp_n) and add tp_n for every negative — i.e. for each
   negative, the number of positives scored strictly-or-tied above it —
-  then divide by P*N.  No tie averaging, matching the reference.
+  then divide by P*N.  No tie averaging: its value under ties depends
+  on sort order, exactly as the reference's does (std::sort order is
+  unspecified within a tie group).  Kept for documentation/tests.
+* ``auc_midrank`` is the canonical Mann-Whitney statistic with midrank
+  tie handling — the REPORTING metric.  Both the single-host path
+  (AucAccumulator) and the multi-host path (HistAuc) use midrank, so
+  the same data reports the same AUC on 1 or N hosts (round-2 advisor
+  finding: sigmoid_ref's clamps create exact ties at 1e-6/1.0, and the
+  two paths previously resolved them differently).
 * ``logloss`` deliberately diverges per the SURVEY quirks ledger: the
   reference computes log2-based, un-negated logloss with a stray ``+ +``
   (base.h:97-98); here it is the standard natural-log negative
@@ -57,10 +65,39 @@ def auc_rank_sum(labels: np.ndarray, pctr: np.ndarray) -> float:
     return area / (p * n)
 
 
+def auc_midrank(labels: np.ndarray, pctr: np.ndarray) -> float:
+    """Exact rank-sum AUC with midrank tie handling (Mann-Whitney U /
+    (P*N)).  Equals ``auc_rank_sum`` whenever pctrs are tie-free;
+    under ties every (pos, neg) pair sharing a pctr counts 1/2 —
+    sort-order independent, and the value HistAuc converges to.
+    Returns NaN when all labels are one class."""
+    labels = np.asarray(labels)
+    pctr = np.asarray(pctr)
+    pos_mask = labels > 0.5
+    p = int(pos_mask.sum())
+    n = len(labels) - p
+    if p == 0 or n == 0:
+        return float("nan")
+    order = np.argsort(pctr, kind="stable")  # ascending
+    sp = pctr[order]
+    first = np.empty(len(sp), bool)  # True at each tie group's start
+    first[0] = True
+    first[1:] = sp[1:] != sp[:-1]
+    starts = np.flatnonzero(first)
+    ends = np.append(starts[1:], len(sp))
+    # midrank of group g = mean of 1-based ranks starts[g]+1 .. ends[g]
+    mid = (starts + 1 + ends) / 2.0
+    ranks = np.empty(len(sp))
+    ranks[order] = mid[np.cumsum(first) - 1]
+    u = ranks[pos_mask].sum() - p * (p + 1) / 2.0
+    return float(u / (p * n))
+
+
 class AucAccumulator:
     """Streaming accumulator for (label, pctr) pairs across eval batches
     (the reference accumulates test_auc_vec under a mutex,
-    lr_worker.cc:62-68, then computes once)."""
+    lr_worker.cc:62-68, then computes once).  AUC uses midrank ties —
+    see module docstring."""
 
     def __init__(self) -> None:
         self._labels: list[np.ndarray] = []
@@ -86,7 +123,7 @@ class AucAccumulator:
             return float("nan"), float("nan")
         p = np.clip(pctr, LOGLOSS_EPS, 1.0 - LOGLOSS_EPS)
         ll = -np.mean(labels * np.log(p) + (1.0 - labels) * np.log(1.0 - p))
-        return float(ll), auc_rank_sum(labels, pctr)
+        return float(ll), auc_midrank(labels, pctr)
 
     def pairs(self) -> tuple[np.ndarray, np.ndarray]:
         labels = np.concatenate(self._labels) if self._labels else np.zeros(0)
