@@ -1,0 +1,212 @@
+"""Binary block cache (io/binary.py): the text parser and the cache
+must be indistinguishable to everything downstream — identical batches,
+identical resume offsets' continuation, table-size independence of one
+cache file — plus the full-key (table_size=0) parse mode it builds on."""
+
+import os
+
+import numpy as np
+import pytest
+
+from xflow_tpu.io import binary
+from xflow_tpu.io.libffm import parse_block
+from xflow_tpu.io.loader import ShardLoader, make_parse_fn
+
+
+def batches_equal(a, b):
+    for f in (
+        "keys", "slots", "vals", "mask", "labels", "weights",
+        "hot_keys", "hot_slots", "hot_vals", "hot_mask",
+    ):
+        np.testing.assert_array_equal(
+            getattr(a, f), getattr(b, f), err_msg=f
+        )
+
+
+def make_loader(path, table_size=1 << 14, **kw):
+    args = dict(
+        batch_size=64, max_nnz=24, table_size=table_size, block_mib=1
+    )
+    args.update(kw)
+    return ShardLoader(path, **args)
+
+
+@pytest.fixture(scope="module")
+def converted(toy_dataset, tmp_path_factory):
+    """First toy shard converted to the binary cache."""
+    src = toy_dataset.train_prefix + "-00000"
+    dst = str(tmp_path_factory.mktemp("bin") / "shard-00000")
+    # ~2 KiB text blocks -> many records, so resume granularity is real
+    meta = binary.convert_shard(src, dst, hash_mode=True, hash_seed=0,
+                                block_mib=0.002)
+    return src, dst, meta
+
+
+def test_convert_header_totals(converted):
+    src, dst, meta = converted
+    assert binary.is_binary_shard(dst)
+    assert not binary.is_binary_shard(src)
+    assert meta["examples"] == 200
+    assert meta["blocks"] >= 1
+    assert binary.shard_example_count(dst) == 200
+    # header survives the in-place rewrite (read back from disk)
+    with open(dst, "rb") as f:
+        reread, _ = binary.read_header(f)
+    assert reread == meta
+
+
+def test_binary_batches_match_text(converted):
+    src, dst, _ = converted
+    text = list(make_loader(src).iter_batches())
+    bin_ = list(make_loader(dst).iter_batches())
+    assert len(text) == len(bin_)
+    for (tb, _), (bb, _) in zip(text, bin_):
+        batches_equal(tb, bb)
+
+
+def test_binary_batches_match_text_hot_remap(converted):
+    """Hot steering + frequency remap apply identically on the cache."""
+    src, dst, _ = converted
+    rng = np.random.default_rng(5)
+    t = 1 << 14
+    remap = rng.permutation(t).astype(np.int32)
+    kw = dict(remap=remap, hot_size=256, hot_nnz=6)
+    text = list(make_loader(src, **kw).iter_batches())
+    bin_ = list(make_loader(dst, **kw).iter_batches())
+    assert len(text) == len(bin_)
+    for (tb, _), (bb, _) in zip(text, bin_):
+        batches_equal(tb, bb)
+
+
+def test_binary_table_size_independent(converted):
+    """ONE cache file serves any table size: keys stored full (64-bit)
+    and reduced at load, bit-identical to parsing the text at that
+    table size."""
+    src, dst, _ = converted
+    for log2 in (10, 18):
+        text = list(make_loader(src, table_size=1 << log2).iter_batches())
+        bin_ = list(make_loader(dst, table_size=1 << log2).iter_batches())
+        for (tb, _), (bb, _) in zip(text, bin_):
+            batches_equal(tb, bb)
+
+
+def test_binary_resume_offsets(converted):
+    """Resuming from a yielded offset re-covers every not-yet-consumed
+    sample, with replay bounded by one record (the same block-
+    granularity contract as the text loader)."""
+    _, dst, meta = converted
+    assert meta["blocks"] > 3  # resume granularity must be real
+    loader = make_loader(dst, batch_size=1)  # per-sample streams
+    full = list(loader.iter_batches())
+    labels = [b.labels[0] for b, _ in full]
+    consumed = 40
+    _, resume = full[consumed - 1]
+    tail = [
+        b.labels[0]
+        for b, _ in loader.iter_batches(start_offset=resume)
+    ]
+    # the resumed stream is a suffix of the full one ...
+    assert len(tail) <= len(labels)
+    np.testing.assert_array_equal(
+        np.asarray(tail), np.asarray(labels[len(labels) - len(tail):])
+    )
+    # ... covering everything unconsumed, with bounded replay (at most
+    # one ~2 KiB record of ~100 B lines)
+    replay = len(tail) - (len(labels) - consumed)
+    assert 0 <= replay <= 25
+
+
+def test_binary_header_mismatch_rejected(converted, tmp_path):
+    _, dst, _ = converted
+    with pytest.raises(ValueError, match="seed"):
+        list(make_loader(dst, hash_seed=99).iter_batches())
+    with pytest.raises(ValueError, match="hash_mode"):
+        list(make_loader(dst, hash_mode=False).iter_batches())
+
+
+def test_convert_prefix_cli(toy_dataset, tmp_path):
+    out = str(tmp_path / "bin")
+    rc = binary.main(
+        ["--train", toy_dataset.train_prefix, "--out", out, "--block-mib", "1"]
+    )
+    assert rc == 0
+    shards = sorted(os.listdir(tmp_path))
+    assert shards == ["bin-00000", "bin-00001", "bin-00002"]
+    # the converted prefix trains end-to-end exactly like the text one
+    from xflow_tpu.config import Config
+    from xflow_tpu.trainer import Trainer
+
+    base = dict(
+        model="lr", epochs=2, batch_size=64, table_size_log2=14,
+        max_nnz=24, num_devices=1, test_path=toy_dataset.test_prefix,
+    )
+    t_text = Trainer(Config(train_path=toy_dataset.train_prefix, **base))
+    t_text.train()
+    t_bin = Trainer(Config(train_path=out, **base))
+    t_bin.train()
+    import jax
+
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(t_text.state["tables"]["w"]["param"])),
+        np.asarray(jax.device_get(t_bin.state["tables"]["w"]["param"])),
+    )
+
+
+def test_full_key_parse_mode():
+    """table_size=0 keeps full 64-bit keys; reducing them afterwards is
+    bit-identical to parsing with the reduction."""
+    data = b"1\t0:alpha:1 3:beta:1\n0\t2:gamma:1\n"
+    full = parse_block(data, 0, hash_mode=True, hash_seed=0)
+    t = 1 << 12
+    reduced = parse_block(data, t, hash_mode=True, hash_seed=0)
+    np.testing.assert_array_equal(
+        binary.reduce_keys(full.keys, t, True), reduced.keys
+    )
+    # numeric mode, including negative fids
+    data_n = b"1\t0:-7:0.5 1:123:1.5\n"
+    full_n = parse_block(data_n, 0, hash_mode=False)
+    red_n = parse_block(data_n, 64, hash_mode=False)
+    assert full_n.keys.tolist() == [-7, 123]
+    np.testing.assert_array_equal(
+        binary.reduce_keys(full_n.keys, 64, False), red_n.keys
+    )
+
+
+def test_python_pack_rejects_wide_keys():
+    """The pure-Python pack fallback must reject keys outside int32 just
+    like the native path (parser.cc returns -2) — never silently wrap.
+    Full 64-bit keys (table_size=0 parse) must be reduced first."""
+    from xflow_tpu.io.batch import ParsedBlock, pack_batch
+
+    block = ParsedBlock(
+        labels=np.asarray([1.0], np.float32),
+        row_ptr=np.asarray([0, 1], np.int64),
+        keys=np.asarray([1 << 33], np.int64),
+        slots=np.asarray([0], np.int32),
+        vals=np.asarray([1.0], np.float32),
+    )
+    with pytest.raises(ValueError, match="int32"):
+        pack_batch(block, 0, 1, 4, 4)
+    neg = ParsedBlock(
+        labels=np.asarray([1.0], np.float32),
+        row_ptr=np.asarray([0, 1], np.int64),
+        keys=np.asarray([-5], np.int64),
+        slots=np.asarray([0], np.int32),
+        vals=np.asarray([1.0], np.float32),
+    )
+    with pytest.raises(ValueError, match="int32"):
+        pack_batch(neg, 0, 1, 4, 4)
+
+
+def test_full_key_parse_native_parity():
+    from xflow_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    data = b"1\t0:alpha:1 3:beta:1\n0\t2:gamma:0.5\n"
+    for hash_mode in (True, False):
+        py = parse_block(data, 0, hash_mode)
+        nat = native.native_parse_block(data, 0, hash_mode)
+        np.testing.assert_array_equal(py.keys, nat.keys)
+        np.testing.assert_array_equal(py.row_ptr, nat.row_ptr)
+        np.testing.assert_array_equal(py.vals, nat.vals)

@@ -262,11 +262,11 @@ def test_native_key_range_guards():
     from xflow_tpu.io.batch import ParsedBlock
 
     # parse: table_size beyond 2^31 would emit keys that can't survive
-    # the downstream int32 batch cast
+    # the downstream int32 batch cast (0 is valid: full keys, no mod)
     with pytest.raises(ValueError, match="table_size"):
         native.native_parse_block(b"1\t0:5:1\n", 1 << 32)
     with pytest.raises(ValueError, match="table_size"):
-        native.native_parse_block(b"1\t0:5:1\n", 0)
+        native.native_parse_block(b"1\t0:5:1\n", -4)
 
     # pack: a raw key outside int32 (e.g. from a direct caller's own
     # CSR block) must raise, not wrap

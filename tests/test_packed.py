@@ -1,0 +1,114 @@
+"""Packed-batch cache (io/packed.py): stored batches must be
+bit-identical to what the text loader assembles at the same config, the
+geometry validation must refuse mismatched caches, and training from a
+packed prefix must reproduce training from text exactly."""
+
+import os
+
+import numpy as np
+import pytest
+
+from xflow_tpu.io import packed
+from xflow_tpu.io.loader import ShardLoader
+
+from tests.test_binary import batches_equal, make_loader
+
+T = 1 << 14
+
+
+@pytest.fixture(scope="module")
+def packed_shard(toy_dataset, tmp_path_factory):
+    src = toy_dataset.train_prefix + "-00000"
+    dst = str(tmp_path_factory.mktemp("pk") / "shard-00000")
+    meta = packed.convert_shard(
+        src, dst, batch_size=64, max_nnz=24, table_size=T, block_mib=0.002
+    )
+    return src, dst, meta
+
+
+def test_packed_matches_text(packed_shard):
+    src, dst, meta = packed_shard
+    assert packed.is_packed_shard(dst)
+    assert meta["examples"] == 200
+    assert packed.shard_example_count(dst) == 200
+    text = list(make_loader(src).iter_batches())
+    pk = list(make_loader(dst).iter_batches())
+    assert len(text) == len(pk) == meta["batches"]
+    for (tb, _), (pb, _) in zip(text, pk):
+        batches_equal(tb, pb)
+
+
+def test_packed_hot_remap(toy_dataset, tmp_path):
+    """Hot geometry + remap bake into the cache; loading with the same
+    remap matches text, with a different remap refuses."""
+    src = toy_dataset.train_prefix + "-00000"
+    dst = str(tmp_path / "hot-00000")
+    rng = np.random.default_rng(3)
+    remap = rng.permutation(T).astype(np.int32)
+    packed.convert_shard(
+        src, dst, batch_size=64, max_nnz=24, table_size=T,
+        hot_size=256, hot_nnz=6, remap=remap, block_mib=0.002,
+    )
+    kw = dict(remap=remap, hot_size=256, hot_nnz=6)
+    text = list(make_loader(src, **kw).iter_batches())
+    pk = list(make_loader(dst, **kw).iter_batches())
+    for (tb, _), (pb, _) in zip(text, pk):
+        batches_equal(tb, pb)
+    other = rng.permutation(T).astype(np.int32)
+    with pytest.raises(ValueError, match="remap_sha256"):
+        list(make_loader(dst, remap=other, hot_size=256, hot_nnz=6).iter_batches())
+
+
+def test_packed_geometry_mismatch_rejected(packed_shard):
+    _, dst, _ = packed_shard
+    with pytest.raises(ValueError, match="batch_size"):
+        list(make_loader(dst, batch_size=32).iter_batches())
+    with pytest.raises(ValueError, match="cold_nnz"):
+        list(make_loader(dst, max_nnz=16).iter_batches())
+    with pytest.raises(ValueError, match="table_size"):
+        list(make_loader(dst, table_size=1 << 12).iter_batches())
+    with pytest.raises(ValueError, match="seed"):
+        list(make_loader(dst, hash_seed=9).iter_batches())
+
+
+def test_packed_resume_exact(packed_shard):
+    """Packed resume offsets are exact (record-aligned): no replay at
+    all, unlike the block-granularity text/CSR caches."""
+    _, dst, _ = packed_shard
+    loader = make_loader(dst)
+    full = list(loader.iter_batches())
+    assert len(full) > 2
+    _, resume = full[0]
+    tail = list(loader.iter_batches(start_offset=resume))
+    assert len(tail) == len(full) - 1
+    for (fb, fo), (tb, to) in zip(full[1:], tail):
+        batches_equal(fb, tb)
+        assert fo == to
+
+
+def test_packed_cli_and_training_parity(toy_dataset, tmp_path):
+    out = str(tmp_path / "pk")
+    rc = packed.main([
+        "--train", toy_dataset.train_prefix, "--out", out,
+        "--batch-size", "64", "--max-nnz", "24",
+        "--table-size-log2", "14", "--block-mib", "0.01",
+    ])
+    assert rc == 0
+    assert sorted(os.listdir(tmp_path)) == ["pk-00000", "pk-00001", "pk-00002"]
+
+    from xflow_tpu.config import Config
+    from xflow_tpu.trainer import Trainer
+    import jax
+
+    base = dict(
+        model="lr", epochs=2, batch_size=64, table_size_log2=14,
+        max_nnz=24, num_devices=1, test_path=toy_dataset.test_prefix,
+    )
+    t_text = Trainer(Config(train_path=toy_dataset.train_prefix, **base))
+    t_text.train()
+    t_pk = Trainer(Config(train_path=out, **base))
+    t_pk.train()
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(t_text.state["tables"]["w"]["param"])),
+        np.asarray(jax.device_get(t_pk.state["tables"]["w"]["param"])),
+    )

@@ -1,0 +1,113 @@
+"""Compact wire format (Config.wire_mode): training and prediction must
+be bit-identical to the full format — compaction only changes what
+crosses the host->device link, never the math."""
+
+import numpy as np
+import pytest
+import jax
+
+from xflow_tpu.config import Config
+from xflow_tpu.trainer import Trainer
+
+
+def _tables(t):
+    return jax.tree.map(
+        lambda a: np.asarray(jax.device_get(a)), t.state["tables"]
+    )
+
+
+@pytest.mark.parametrize("model", ["lr", "fm"])
+@pytest.mark.parametrize("hot", [False, True])
+def test_compact_equals_full(toy_dataset, model, hot, tmp_path):
+    base = dict(
+        model=model,
+        train_path=toy_dataset.train_prefix,
+        test_path=toy_dataset.test_prefix,
+        epochs=2,
+        batch_size=64,
+        table_size_log2=14,
+        max_nnz=24,
+        num_devices=1,
+    )
+    if hot:
+        base.update(
+            hot_size_log2=8, hot_nnz=8, freq_sample_mib=1,
+            checkpoint_dir=str(tmp_path / f"{model}-ck"),
+        )
+    t_full = Trainer(Config(wire_mode="full", **base))
+    assert not t_full.step.compact_wire
+    t_full.train()
+    r_full = t_full.evaluate()
+
+    t_cmp = Trainer(Config(wire_mode="compact", **base))
+    assert t_cmp.step.compact_wire
+    t_cmp.train()
+    r_cmp = t_cmp.evaluate()
+
+    # not bit-exact: the two wire formats compile to different XLA
+    # programs (mask*mask vs vals*mask fuses differently), so reduction
+    # orders may differ at float32 epsilon scale — but nothing more
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+        _tables(t_full),
+        _tables(t_cmp),
+    )
+    np.testing.assert_allclose(r_full["logloss"], r_cmp["logloss"], rtol=1e-5)
+    np.testing.assert_allclose(r_full["auc"], r_cmp["auc"], rtol=1e-5)
+
+
+def test_compact_rejected_for_slot_models(toy_dataset):
+    with pytest.raises(ValueError, match="compact"):
+        Trainer(
+            Config(
+                model="mvm",
+                wire_mode="compact",
+                train_path=toy_dataset.train_prefix,
+                batch_size=64,
+                table_size_log2=14,
+                num_devices=1,
+            )
+        )
+
+
+def test_auto_picks_compact_only_when_valid(toy_dataset):
+    common = dict(
+        train_path=toy_dataset.train_prefix,
+        batch_size=64,
+        table_size_log2=14,
+        num_devices=1,
+    )
+    assert Trainer(Config(model="lr", **common)).step.compact_wire
+    assert not Trainer(Config(model="mvm", **common)).step.compact_wire
+    # numeric mode carries real values -> full wire even for lr
+    assert not Trainer(
+        Config(model="lr", hash_mode=False, **common)
+    ).step.compact_wire
+
+
+def test_compact_guards_value_batches():
+    """User-built batches with fractional vals/weights must be refused,
+    not silently binarized."""
+    from xflow_tpu.io.batch import Batch
+    from xflow_tpu.parallel.step import batch_to_compact
+
+    b = Batch(
+        keys=np.zeros((2, 3), np.int32),
+        slots=np.zeros((2, 3), np.int32),
+        vals=np.asarray([[0.5, 1, 1], [1, 1, 1]], np.float32),
+        mask=np.ones((2, 3), np.float32),
+        labels=np.zeros(2, np.float32),
+        weights=np.ones(2, np.float32),
+    )
+    with pytest.raises(ValueError, match="binary features"):
+        batch_to_compact(b)
+    b2 = Batch(
+        keys=np.zeros((2, 3), np.int32),
+        slots=np.zeros((2, 3), np.int32),
+        vals=np.ones((2, 3), np.float32),
+        mask=np.ones((2, 3), np.float32),
+        labels=np.zeros(2, np.float32),
+        weights=np.asarray([1.0, 0.25], np.float32),
+    )
+    with pytest.raises(ValueError, match="0/1"):
+        batch_to_compact(b2)

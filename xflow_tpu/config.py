@@ -168,6 +168,18 @@ class Config:
     # for FTRL state (z accumulates small increments).
     param_dtype: str = "float32"
 
+    # -- host->device wire format --
+    # "full": ship keys/slots/vals/mask/labels/weights as-is.
+    # "compact": ship sentinel-coded int32 keys (-1 = padding) + uint8
+    #   labels/weights only (~4x fewer bytes) and reconstruct
+    #   vals/mask/slots inside the jitted step.  Valid only in hash mode
+    #   (vals are identically 1, load_data_from_disk.cc:151) with a
+    #   model that never reads slots (lr, fm).  On links where
+    #   host->device bandwidth bounds e2e throughput (measured ~150-250
+    #   MB/s here, docs/PERF.md) this is a ~4x e2e lever.
+    # "auto" (default): compact whenever valid, else full.
+    wire_mode: str = "auto"  # {"auto", "full", "compact"}
+
     def __post_init__(self) -> None:
         if self.model not in ("lr", "fm", "mvm", "ffm", "wide_deep"):
             raise ValueError(f"unknown model {self.model!r}")
@@ -190,6 +202,8 @@ class Config:
             raise ValueError(f"unknown hot_dtype {self.hot_dtype!r}")
         if self.pred_style not in ("single", "per_block"):
             raise ValueError(f"unknown pred_style {self.pred_style!r}")
+        if self.wire_mode not in ("auto", "full", "compact"):
+            raise ValueError(f"unknown wire_mode {self.wire_mode!r}")
 
     @property
     def table_size(self) -> int:

@@ -168,6 +168,18 @@ def pack_batch(
     """
     n = end - start
     assert 0 < n <= batch_size
+    # Keys narrow to int32 batch arrays; reject, never wrap — the same
+    # guard the native pack enforces (parser.cc returns -2).  Scoped to
+    # the packed slice so the check is O(slice nnz).
+    lo, hi = int(block.row_ptr[start]), int(block.row_ptr[end])
+    if hi > lo:
+        kslice = block.keys[lo:hi]
+        if kslice.min() < 0 or kslice.max() > np.iinfo(np.int32).max:
+            raise ValueError(
+                "pack_batch: a key exceeds int32 — table_size too large "
+                "for the int32 batch arrays (full 64-bit keys must be "
+                "reduced before packing)"
+            )
     ktot = max_nnz + (hot_nnz if hot_size else 0)
     labels = np.zeros(batch_size, dtype=np.float32)
     weights = np.zeros(batch_size, dtype=np.float32)

@@ -37,12 +37,24 @@ def count_keys(
 ) -> np.ndarray:
     """Count key occurrences over up to ``sample_bytes`` of data taken
     from the front of ``paths`` in order.  Returns int64 [table_size]."""
+    from xflow_tpu.io import binary
+
     counts = np.zeros(table_size, dtype=np.int64)
     remaining = sample_bytes
     for path in paths:
         if remaining <= 0:
             break
         with open(path, "rb") as f:
+            if f.read(len(binary.MAGIC)) == binary.MAGIC:
+                # binary block cache: records already hold keys
+                for block, off, noff in binary.iter_blocks(f, table_size):
+                    if len(block.keys):
+                        np.add.at(counts, block.keys, 1)
+                    remaining -= noff - off
+                    if remaining <= 0:
+                        break
+                continue
+            f.seek(0)
             for raw in BlockReader(f, block_bytes):
                 block = parse_fn(raw)
                 if len(block.keys):

@@ -69,7 +69,10 @@ def parse_block(
 
     Keys are reduced modulo ``table_size`` (the TPU table is a dense
     array, unlike the reference's unbounded server-side hash map,
-    ftrl.h:84).
+    ftrl.h:84).  ``table_size=0`` keeps FULL keys — the 64-bit hash
+    (two's-complement int64 view) in hash mode, the raw fid in numeric
+    mode — for the binary block cache (io/binary.py, table-size-
+    independent) and collision accounting.
     """
     labels: list[float] = []
     row_ptr: list[int] = [0]
@@ -124,9 +127,14 @@ def parse_block(
 
     if hash_mode:
         hashed = murmur64_batch(tokens, seed=hash_seed)
-        keys = (hashed % np.uint64(table_size)).astype(np.int64)
+        if table_size:
+            keys = (hashed % np.uint64(table_size)).astype(np.int64)
+        else:
+            keys = hashed.view(np.int64)
     else:
-        keys = np.asarray(fids, dtype=np.int64) % table_size
+        keys = np.asarray(fids, dtype=np.int64)
+        if table_size:
+            keys = keys % table_size
 
     return ParsedBlock(
         labels=np.asarray(labels, dtype=np.float32),

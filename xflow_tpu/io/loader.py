@@ -99,6 +99,8 @@ class ShardLoader:
         self.max_nnz = max_nnz
         self.table_size = table_size
         self.block_bytes = block_mib << 20
+        self.hash_mode = hash_mode
+        self.hash_seed = hash_seed
         if parse_fn is None:
             parse_fn = lambda data: parse_block(
                 data, table_size, hash_mode, hash_seed
@@ -114,8 +116,7 @@ class ShardLoader:
 
         self._native_pack = native.available()
 
-    def _parse_remap(self, raw: bytes) -> ParsedBlock:
-        block = self.parse_fn(raw)
+    def _apply_remap(self, block: ParsedBlock) -> ParsedBlock:
         if (
             self.remap is not None
             and not self._native_pack
@@ -124,6 +125,9 @@ class ShardLoader:
             # frequency remap: pure row-placement permutation (io/freq.py)
             block.keys = self.remap[block.keys]
         return block
+
+    def _parse_remap(self, raw: bytes) -> ParsedBlock:
+        return self._apply_remap(self.parse_fn(raw))
 
     def _pack(self, block: ParsedBlock, start: int, end: int) -> Batch:
         if self._native_pack:
@@ -160,8 +164,24 @@ class ShardLoader:
         release the GIL for the heavy part) — the TPU-era replacement
         for the reference's per-minibatch ThreadPool fan-out
         (lr_worker.cc:190-196).
+
+        Binary block-cache shards (io/binary.py, sniffed by magic) skip
+        parsing entirely — records stream at memory speed; parse_workers
+        is irrelevant there.  Packed-batch shards (io/packed.py) skip
+        batch assembly too: records ARE finished device-ready batches.
+        The (batch, resume_offset) contract is identical for all three
+        formats.
         """
+        from xflow_tpu.io import binary, packed
+
         with open(self.path, "rb") as f:
+            magic = f.read(len(binary.MAGIC))
+            if magic == binary.MAGIC:
+                yield from self._iter_binary(f, start_offset)
+                return
+            if magic == packed.MAGIC:
+                yield from self._iter_packed(f, start_offset)
+                return
             f.seek(start_offset)
 
             def parsed_blocks() -> Iterator[tuple[ParsedBlock, int, int]]:
@@ -190,30 +210,83 @@ class ShardLoader:
                         fut, off, noff = pending.popleft()
                         yield fut.result(), off, noff
 
-            carry: ParsedBlock | None = None
-            end_offset = start_offset
-            for block, raw_offset, next_offset in parsed_blocks():
-                end_offset = next_offset
-                if carry is not None and carry.num_samples:
-                    block = _concat_blocks(carry, block)
-                carry = None
-                n = block.num_samples
-                start = 0
-                while n - start >= self.batch_size:
-                    end = start + self.batch_size
-                    # resume = earliest block holding a not-yet-yielded
-                    # sample.  The carry is always < batch_size samples,
-                    # so the first batch of this loop consumes it whole:
-                    # unyielded samples start in this raw block (or past
-                    # it entirely when end == n).
-                    resume = next_offset if end == n else raw_offset
-                    yield self._pack(block, start, end), resume
-                    start = end
-                if start < n:
-                    carry = _slice_block(block, start)
+            yield from self._batches_from_blocks(parsed_blocks(), start_offset)
+
+    def _iter_binary(
+        self, f, start_offset: int
+    ) -> Iterator[tuple[Batch, int]]:
+        """Batch stream over a binary block-cache shard (io/binary.py):
+        records already hold parsed CSR; reduction to [0, table_size)
+        and the remap happen at load."""
+        from xflow_tpu.io import binary
+
+        blocks = (
+            (self._apply_remap(b), off, noff)
+            for b, off, noff in binary.iter_blocks(
+                f,
+                self.table_size,
+                start_offset,
+                expect_hash_mode=self.hash_mode,
+                expect_hash_seed=self.hash_seed,
+            )
+        )
+        yield from self._batches_from_blocks(blocks, start_offset)
+
+    def _iter_packed(
+        self, f, start_offset: int
+    ) -> Iterator[tuple[Batch, int]]:
+        """Batch stream over a packed-batch shard (io/packed.py): each
+        record is a finished Batch — no parse, no assembly.  The cache's
+        baked-in batch geometry must match this loader exactly."""
+        from xflow_tpu.io import packed
+
+        f.seek(0)
+        meta, _ = packed.read_header(f)
+        packed.check_compat(
+            meta,
+            batch_size=self.batch_size,
+            cold_nnz=self.max_nnz,
+            hot_nnz=self.hot_nnz if self.hot_size else 0,
+            hot_size=self.hot_size,
+            table_size=self.table_size,
+            hash_mode=self.hash_mode,
+            hash_seed=self.hash_seed,
+            remap=self.remap,
+        )
+        for batch, _, next_offset in packed.iter_batches(f, start_offset):
+            yield batch, next_offset
+
+    def _batches_from_blocks(
+        self,
+        blocks: Iterator[tuple[ParsedBlock, int, int]],
+        start_offset: int,
+    ) -> Iterator[tuple[Batch, int]]:
+        """Shared carry/batch assembly over any (block, offset,
+        next_offset) source (text parser or binary cache)."""
+        carry: ParsedBlock | None = None
+        end_offset = start_offset
+        for block, raw_offset, next_offset in blocks:
+            end_offset = next_offset
             if carry is not None and carry.num_samples:
-                # the stream's final (partial) batch consumes everything
-                yield self._pack(carry, 0, carry.num_samples), end_offset
+                block = _concat_blocks(carry, block)
+            carry = None
+            n = block.num_samples
+            start = 0
+            while n - start >= self.batch_size:
+                end = start + self.batch_size
+                # resume = earliest block holding a not-yet-yielded
+                # sample.  The carry is always < batch_size samples,
+                # so the first batch of this loop consumes it whole:
+                # unyielded samples start in this raw block (or past
+                # it entirely when end == n).
+                resume = next_offset if end == n else raw_offset
+                yield self._pack(block, start, end), resume
+                start = end
+            if start < n:
+                carry = _slice_block(block, start)
+        if carry is not None and carry.num_samples:
+            # the stream's final (partial) batch consumes everything
+            yield self._pack(carry, 0, carry.num_samples), end_offset
 
     def prefetch(
         self, depth: int, start_offset: int = 0, parse_workers: int = 0
@@ -225,6 +298,12 @@ class ShardLoader:
         )
 
     def count_examples(self) -> int:
+        from xflow_tpu.io import binary, packed
+
+        if binary.is_binary_shard(self.path):
+            return binary.shard_example_count(self.path)
+        if packed.is_packed_shard(self.path):
+            return packed.shard_example_count(self.path)
         n = 0
         with open(self.path, "rb") as f:
             for line in f:

@@ -1,0 +1,306 @@
+"""Packed-batch cache: device-ready batches on disk.
+
+The CSR binary cache (io/binary.py) removes text parsing but still pays
+CSR→padded assembly (~15 ns/entry in native pack — the host bottleneck
+once parsing is gone; docs/PERF.md).  For steady-state multi-epoch
+training at a FIXED batch configuration — the reference's workload is
+60 epochs over the same shards (lr_worker.h:63) — even that can be
+precomputed: this cache stores finished ``Batch`` arrays; reading one
+is a header-driven buffer slice (zero copy, no per-entry work), so the
+host side runs at memory speed and the device step becomes the
+bottleneck.
+
+The trade against io/binary.py: a packed cache bakes in batch_size,
+max_nnz, table_size, hot geometry, and the hot remap (keys are stored
+POST-remap, steered into hot/cold sections).  Change any of those and
+the cache must be rebuilt — the loader validates every one of them
+(including a hash of the remap) and refuses silently-wrong reads.
+
+Format (little-endian):
+
+    magic   8 bytes  b"XFPB0001"
+    hlen    u32, header JSON:
+      {"version": 1, "batch_size": B, "cold_nnz": K, "hot_nnz": Kh,
+       "hot_size": H, "table_size": T, "hash_mode": bool,
+       "hash_seed": int, "remap_sha256": hex|null, "batches": n,
+       "examples": n}
+    then ``batches`` fixed-size records, each the concatenation of
+      keys i32[B,K] | slots i32[B,K] | vals f32[B,K] | mask f32[B,K]
+      | hot_keys i32[B,Kh] | hot_slots i32[B,Kh] | hot_vals f32[B,Kh]
+      | hot_mask f32[B,Kh] | labels f32[B] | weights f32[B]
+
+Records have constant size, so a resume offset is plain arithmetic and
+random access is free.  The final (partial) batch of a shard is stored
+as-is — weights already encode padding.
+
+Convert via the CLI (from text or CSR-binary shards):
+
+    python -m xflow_tpu.io.packed --train PREFIX --out PREFIX.pk \
+        --batch-size N --max-nnz K --table-size-log2 T \
+        [--hot-size-log2 H --hot-nnz Kh --remap remap.npy] [...]
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import BinaryIO, Iterator
+
+import numpy as np
+
+from xflow_tpu.io import container
+from xflow_tpu.io.batch import Batch
+
+MAGIC = b"XFPB0001"
+
+
+def remap_digest(remap: np.ndarray | None) -> str | None:
+    if remap is None:
+        return None
+    return hashlib.sha256(
+        np.ascontiguousarray(remap, np.int32).tobytes()
+    ).hexdigest()
+
+
+def is_packed_shard(path: str) -> bool:
+    return container.sniff(path, MAGIC)
+
+
+def read_header(f: BinaryIO) -> tuple[dict, int]:
+    return container.read_header(f, MAGIC, "packed shard")
+
+
+def _layout(meta: dict) -> tuple[list[tuple[str, tuple, np.dtype]], int]:
+    """(field, shape, dtype) per record section, and the record size."""
+    b = meta["batch_size"]
+    k = meta["cold_nnz"]
+    kh = meta["hot_nnz"]
+    fields = [
+        ("keys", (b, k), np.dtype(np.int32)),
+        ("slots", (b, k), np.dtype(np.int32)),
+        ("vals", (b, k), np.dtype(np.float32)),
+        ("mask", (b, k), np.dtype(np.float32)),
+        ("hot_keys", (b, kh), np.dtype(np.int32)),
+        ("hot_slots", (b, kh), np.dtype(np.int32)),
+        ("hot_vals", (b, kh), np.dtype(np.float32)),
+        ("hot_mask", (b, kh), np.dtype(np.float32)),
+        ("labels", (b,), np.dtype(np.float32)),
+        ("weights", (b,), np.dtype(np.float32)),
+    ]
+    size = sum(int(np.prod(s)) * d.itemsize for _, s, d in fields)
+    return fields, size
+
+
+def check_compat(
+    meta: dict,
+    *,
+    batch_size: int,
+    cold_nnz: int,
+    hot_nnz: int,
+    hot_size: int,
+    table_size: int,
+    hash_mode: bool,
+    hash_seed: int,
+    remap: np.ndarray | None,
+) -> None:
+    """Raise unless the cache was built for exactly this batch config."""
+    want = {
+        "batch_size": batch_size,
+        "cold_nnz": cold_nnz,
+        "hot_nnz": hot_nnz,
+        "hot_size": hot_size,
+        "table_size": table_size,
+        "hash_mode": bool(hash_mode),
+        "remap_sha256": remap_digest(remap),
+    }
+    for key, val in want.items():
+        if meta.get(key) != val:
+            raise ValueError(
+                f"packed shard built with {key}={meta.get(key)!r}, "
+                f"loader expects {val!r} — rebuild the cache "
+                "(python -m xflow_tpu.io.packed)"
+            )
+    if meta["hash_mode"] and int(meta["hash_seed"]) != int(hash_seed):
+        raise ValueError(
+            f"packed shard hashed with seed {meta['hash_seed']}, "
+            f"loader expects {hash_seed}"
+        )
+
+
+def write_shard(
+    dst: str, meta: dict, batches: Iterator[Batch]
+) -> dict:
+    """Stream ``batches`` into a packed shard (atomic temp + rename).
+    ``meta`` must hold the config keys of check_compat; totals are
+    filled in here."""
+    fields, _ = _layout(meta)
+    tmp = f"{dst}.tmp.{os.getpid()}"
+    os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
+    n_batches = 0
+    examples = 0
+    try:
+        with open(tmp, "wb") as f:
+            header = {"version": 1, **meta}
+            hdr_len = container.write_placeholder_header(
+                f, MAGIC, header, ("batches", "examples")
+            )
+            for batch in batches:
+                for name, shape, dtype in fields:
+                    arr = getattr(batch, name)
+                    if arr.shape != shape or arr.dtype != dtype:
+                        raise ValueError(
+                            f"batch field {name}: {arr.shape}/{arr.dtype} "
+                            f"!= cache layout {shape}/{dtype}"
+                        )
+                    f.write(np.ascontiguousarray(arr).tobytes())
+                n_batches += 1
+                examples += batch.num_real()
+            header.update({"batches": n_batches, "examples": examples})
+            container.rewrite_header(f, MAGIC, header, hdr_len)
+        os.replace(tmp, dst)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    header.pop("version")
+    return header
+
+
+def iter_batches(
+    f: BinaryIO, start_offset: int = 0
+) -> Iterator[tuple[Batch, int, int]]:
+    """Yield (batch, offset, next_offset).  Batch arrays are read-only
+    zero-copy views of each record's buffer — the whole point of this
+    format; copy before mutating."""
+    f.seek(0)
+    meta, data_start = read_header(f)
+    fields, rec_size = _layout(meta)
+    offset = max(int(start_offset), data_start)
+    if (offset - data_start) % rec_size:
+        raise ValueError(
+            f"start_offset {start_offset} is not a record boundary"
+        )
+    f.seek(offset)
+    while True:
+        buf = f.read(rec_size)
+        if not buf:
+            return
+        if len(buf) != rec_size:
+            raise ValueError("truncated packed shard record")
+        pos = 0
+        kw = {}
+        for name, shape, dtype in fields:
+            nbytes = int(np.prod(shape)) * dtype.itemsize
+            kw[name] = np.frombuffer(
+                buf, dtype, count=int(np.prod(shape)), offset=pos
+            ).reshape(shape)
+            pos += nbytes
+        next_offset = offset + rec_size
+        yield Batch(**kw), offset, next_offset
+        offset = next_offset
+
+
+def shard_example_count(path: str) -> int:
+    with open(path, "rb") as f:
+        meta, _ = read_header(f)
+        return int(meta["examples"])
+
+
+def convert_shard(
+    src: str,
+    dst: str,
+    *,
+    batch_size: int,
+    max_nnz: int,
+    table_size: int,
+    hot_size: int = 0,
+    hot_nnz: int = 0,
+    hash_mode: bool = True,
+    hash_seed: int = 0,
+    block_mib: float = 8,
+    remap: np.ndarray | None = None,
+    parse_fn=None,
+) -> dict:
+    """Pack one shard (text or CSR-binary — ShardLoader sniffs) into
+    device-ready batches."""
+    from xflow_tpu.io.loader import ShardLoader
+
+    loader = ShardLoader(
+        src,
+        batch_size=batch_size,
+        max_nnz=max_nnz,
+        table_size=table_size,
+        block_mib=max(1, int(block_mib)),
+        hash_mode=hash_mode,
+        hash_seed=hash_seed,
+        parse_fn=parse_fn,
+        remap=remap,
+        hot_size=hot_size,
+        hot_nnz=hot_nnz,
+    )
+    loader.block_bytes = max(1, int(block_mib * (1 << 20)))
+    meta = {
+        "batch_size": batch_size,
+        "cold_nnz": max_nnz,
+        "hot_nnz": hot_nnz if hot_size else 0,
+        "hot_size": hot_size,
+        "table_size": table_size,
+        "hash_mode": bool(hash_mode),
+        "hash_seed": int(hash_seed),
+        "remap_sha256": remap_digest(remap),
+    }
+    return write_shard(
+        dst, meta, (b for b, _ in loader.iter_batches())
+    )
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from xflow_tpu.io import freq
+    from xflow_tpu.trainer import find_shards
+
+    p = argparse.ArgumentParser(
+        prog="xflow_tpu.io.packed",
+        description="pack shards into device-ready batch caches",
+    )
+    p.add_argument("--train", required=True, help="text/CSR shard prefix")
+    p.add_argument("--out", required=True, help="output shard prefix")
+    p.add_argument("--batch-size", type=int, required=True)
+    p.add_argument("--max-nnz", type=int, required=True)
+    p.add_argument("--table-size-log2", type=int, required=True)
+    p.add_argument("--hot-size-log2", type=int, default=0)
+    p.add_argument("--hot-nnz", type=int, default=0)
+    p.add_argument("--remap", help=".npy hot remap (trainer's remap.npy)")
+    p.add_argument("--no-hash", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--block-mib", type=float, default=8)
+    a = p.parse_args(argv)
+    remap = freq.load_remap(a.remap) if a.remap else None
+    if a.hot_size_log2 and remap is None:
+        p.error("--hot-size-log2 requires --remap (trainer's remap.npy)")
+    for i, src in enumerate(find_shards(a.train)):
+        dst = f"{a.out}-{i:05d}" if src != a.train else a.out
+        meta = convert_shard(
+            src,
+            dst,
+            batch_size=a.batch_size,
+            max_nnz=a.max_nnz,
+            table_size=1 << a.table_size_log2,
+            hot_size=(1 << a.hot_size_log2) if a.hot_size_log2 else 0,
+            hot_nnz=a.hot_nnz,
+            hash_mode=not a.no_hash,
+            hash_seed=a.seed,
+            block_mib=a.block_mib,
+            remap=remap,
+        )
+        print(
+            f"{src} -> {dst}: {meta['examples']} examples in "
+            f"{meta['batches']} batches"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

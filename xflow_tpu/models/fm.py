@@ -34,6 +34,9 @@ class FMModel:
     v_dim: int = 10  # reference: ftrl.h:16
     v_init_scale: float = 1e-2
     name: str = "fm"
+    # never reads batch["slots"] (the 2-way interaction sums over ALL
+    # features, fm_worker.cc:63-86) — compact-wire eligible (step.py)
+    uses_slots = False
 
     def tables(self) -> list[TableSpec]:
         return [

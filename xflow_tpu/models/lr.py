@@ -22,6 +22,9 @@ from xflow_tpu.models.base import BatchArrays, TableSpec
 
 class LRModel:
     name = "lr"
+    # never reads batch["slots"] — eligible for the compact wire format
+    # (parallel/step.py put_batch: keys+labels only over the host link)
+    uses_slots = False
 
     def tables(self) -> list[TableSpec]:
         # w entries are zero-initialized server-side in the reference

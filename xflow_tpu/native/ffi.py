@@ -117,10 +117,12 @@ def native_parse_block(
     # Keys must survive the downstream int32 batch cast (xf_pack_batch);
     # Config guards table_size_log2 <= 30 on the CLI path, but this
     # entry point is callable directly (round-2 advisor finding).
-    if not 0 < table_size <= (1 << 31):
+    # table_size == 0 = no reduction (full 64-bit keys for the binary
+    # block cache / collision accounting — never fed to pack directly).
+    if table_size != 0 and not 0 < table_size <= (1 << 31):
         raise ValueError(
             f"table_size {table_size} out of range (0, 2^31] — parsed "
-            "keys must fit int32 batch arrays"
+            "keys must fit int32 batch arrays (0 = keep full keys)"
         )
     # capacity bounds: every sample has one line; every feature token has
     # exactly 2 of the block's ':' bytes

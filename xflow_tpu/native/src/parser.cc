@@ -19,7 +19,9 @@
 //     val is DISCARDED (features binary, vals=1); in numeric mode fid
 //     must parse as integer and val as float, both kept
 //   * malformed tokens are skipped, not fatal
-//   * keys reduced modulo table_size
+//   * keys reduced modulo table_size; table_size == 0 keeps FULL keys
+//     (the 64-bit hash as two's-complement int64 / the raw fid) for the
+//     binary block cache (io/binary.py) and collision accounting
 
 #include <cerrno>
 #include <cmath>
@@ -200,7 +202,8 @@ int64_t xf_parse_block(const char* data, int64_t len, int64_t table_size,
               if (nnz == max_nnz) return -1;
               uint64_t h = murmur64a(c1 + 1, c2 - c1 - 1, seed);
               keys[nnz] = static_cast<int64_t>(
-                  h % static_cast<uint64_t>(table_size));
+                  table_size > 0 ? h % static_cast<uint64_t>(table_size)
+                                 : h);
               slots[nnz] = fgid;
               vals[nnz] = 1.0f;  // value field discarded: binary features
               ++nnz;
@@ -214,8 +217,11 @@ int64_t xf_parse_block(const char* data, int64_t len, int64_t table_size,
                   // libffm.py's finite-in-float32 rule exactly
                   std::isfinite(val)) {
                 if (nnz == max_nnz) return -1;
-                int64_t k = fid % table_size;
-                if (k < 0) k += table_size;
+                int64_t k = fid;
+                if (table_size > 0) {
+                  k = fid % table_size;
+                  if (k < 0) k += table_size;
+                }
                 keys[nnz] = k;
                 slots[nnz] = fgid;
                 vals[nnz] = val;

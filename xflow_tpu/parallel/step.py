@@ -87,6 +87,56 @@ def batch_to_arrays(batch: Batch) -> BatchArrays:
     return out
 
 
+def validate_compact_batch(batch: Batch) -> None:
+    """Compact-wire invariants: binary features (val 1 wherever mask 1)
+    and 0/1 labels/weights.  Loader-produced hash-mode batches satisfy
+    them by construction, so put_batch validates only the FIRST batch
+    per TrainStep — full [B,K] scans on every batch would burn the host
+    CPU the compact format exists to relieve."""
+    import numpy as np
+
+    if not (
+        np.array_equal(batch.vals * batch.mask, batch.mask)
+        and np.array_equal(batch.hot_vals * batch.hot_mask, batch.hot_mask)
+    ):
+        raise ValueError(
+            "compact wire requires binary features (val 1 wherever "
+            "mask 1); set wire_mode='full' for value-carrying batches"
+        )
+    for arr in (batch.labels, batch.weights):
+        if not np.isin(arr, (0.0, 1.0)).all():
+            raise ValueError(
+                "compact wire requires 0/1 labels and weights; set "
+                "wire_mode='full'"
+            )
+
+
+def batch_to_compact(batch: Batch, check: bool = True) -> BatchArrays:
+    """Compact wire (Config.wire_mode): sentinel-coded keys + uint8
+    labels/weights — ~16x fewer bytes/entry than the full format.
+    Only valid when vals are identically 1 for real entries (hash mode)
+    and the model never reads slots; _expand_wire reconstructs
+    vals/mask/slots on device."""
+    import numpy as np
+
+    if check:
+        validate_compact_batch(batch)
+
+    def sentinel(keys, mask):
+        return jnp.asarray(
+            np.where(mask > 0, keys, np.int32(-1)).astype(np.int32)
+        )
+
+    out = {
+        "ckeys": sentinel(batch.keys, batch.mask),
+        "labels_u8": jnp.asarray(batch.labels.astype(np.uint8)),
+        "weights_u8": jnp.asarray(batch.weights.astype(np.uint8)),
+    }
+    if batch.hot_nnz:
+        out["hot_ckeys"] = sentinel(batch.hot_keys, batch.hot_mask)
+    return out
+
+
 class TrainStep:
     """Holds the compiled train/predict functions for one (model,
     optimizer, config, mesh) combination."""
@@ -100,13 +150,30 @@ class TrainStep:
         self._hot_dtype = (
             jnp.bfloat16 if cfg.hot_dtype == "bfloat16" else jnp.float32
         )
+        # Compact wire eligibility (Config.wire_mode): requires binary
+        # vals (hash mode) and a slot-free model.
+        compact_ok = cfg.hash_mode and not getattr(model, "uses_slots", True)
+        if cfg.wire_mode == "compact" and not compact_ok:
+            raise ValueError(
+                "wire_mode='compact' requires hash_mode and a model that "
+                f"ignores slots; model {model.name!r} / hash_mode="
+                f"{cfg.hash_mode} does not qualify"
+            )
+        self.compact_wire = cfg.wire_mode != "full" and compact_ok
+        self._compact_validated = False
         self.train = jax.jit(self._train_impl, donate_argnums=0)
         self.predict = jax.jit(self._predict_impl)
 
     # -- helpers -----------------------------------------------------------
 
     def put_batch(self, batch: Batch) -> BatchArrays:
-        arrays = batch_to_arrays(batch)
+        if self.compact_wire:
+            arrays = batch_to_compact(
+                batch, check=not self._compact_validated
+            )
+            self._compact_validated = True
+        else:
+            arrays = batch_to_arrays(batch)
         if jax.process_count() > 1:
             # Each host loaded its own shard subset (trainer._my_shards);
             # assemble a global array from per-process local batches.
@@ -121,6 +188,31 @@ class TrainStep:
         return {
             k: jax.device_put(v, self._bsharding) for k, v in arrays.items()
         }
+
+    def _expand_wire(self, batch: BatchArrays) -> BatchArrays:
+        """Inverse of batch_to_compact, inside the jitted step: padding
+        is key == -1; real entries have val = mask = 1 (hash mode);
+        slots are never read by compact-eligible models (zeros)."""
+        if "ckeys" not in batch:
+            return batch
+        ckeys = batch["ckeys"]
+        mask = (ckeys >= 0).astype(jnp.float32)
+        out = {
+            "keys": jnp.maximum(ckeys, 0),
+            "slots": jnp.zeros_like(ckeys),
+            "vals": mask,
+            "mask": mask,
+            "labels": batch["labels_u8"].astype(jnp.float32),
+            "weights": batch["weights_u8"].astype(jnp.float32),
+        }
+        if "hot_ckeys" in batch:
+            hot = batch["hot_ckeys"]
+            hmask = (hot >= 0).astype(jnp.float32)
+            out["hot_keys"] = jnp.maximum(hot, 0)
+            out["hot_slots"] = jnp.zeros_like(hot)
+            out["hot_vals"] = hmask
+            out["hot_mask"] = hmask
+        return out
 
     def _gather_model_rows(
         self, tables: dict[str, dict[str, jax.Array]], batch: BatchArrays
@@ -183,6 +275,7 @@ class TrainStep:
         self, state: State, batch: BatchArrays
     ) -> tuple[State, dict[str, jax.Array]]:
         cfg = self.cfg
+        batch = self._expand_wire(batch)
         tables = state["tables"]
         dense = state["dense"]
         rows = self._gather_model_rows(tables, batch)
@@ -287,6 +380,7 @@ class TrainStep:
 
     def _predict_impl(self, state: State, batch: BatchArrays) -> jax.Array:
         """pctr per example (reference calculate_pctr, lr_worker.cc:46-61)."""
+        batch = self._expand_wire(batch)
         rows = self._gather_model_rows(state["tables"], batch)
         return sigmoid_ref(
             self._logit(rows, self._model_view(batch), state["dense"])

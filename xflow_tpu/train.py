@@ -71,6 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--hot-dtype", choices=["float32", "bfloat16"], dest="hot_dtype"
     )
+    p.add_argument(
+        "--wire-mode", choices=["auto", "full", "compact"], dest="wire_mode",
+        help="host->device batch format; compact ships ~4x fewer bytes "
+        "(hash-mode lr/fm only)",
+    )
     p.add_argument("--pred-out", dest="pred_out")
     p.add_argument(
         "--pred-style", choices=["single", "per_block"], dest="pred_style",

@@ -20,7 +20,8 @@ import glob
 import os
 import sys
 import time
-from typing import Callable, Iterator
+from collections import deque
+from typing import Any, Callable, Iterator
 
 import numpy as np
 
@@ -296,6 +297,30 @@ class Trainer:
                     pad = self._empty_batch()
                 yield pad, last[0], last[1]
 
+    def _transfer_ahead(
+        self, it: Iterator[tuple[Batch, int, int]], depth: int = 2
+    ) -> Iterator[tuple[Any, int, int]]:
+        """Run put_batch (host->device transfer) ``depth`` items ahead on
+        a worker thread so link round-trips overlap device compute —
+        measured 2-3x e2e on the tunneled link (docs/PERF.md).
+        Single-host only: multi-host put_batch is collective
+        (host_local_array_to_global_array) and must stay on the voting
+        thread."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(1) as ex:
+            pending: deque = deque()
+            for batch, si, resume in it:
+                pending.append(
+                    (ex.submit(self.step.put_batch, batch), si, resume)
+                )
+                if len(pending) > depth:
+                    fut, psi, presume = pending.popleft()
+                    yield fut.result(), psi, presume
+            while pending:
+                fut, psi, presume = pending.popleft()
+                yield fut.result(), psi, presume
+
     def prepare_batch(self, batch: Batch) -> Batch:
         """Bring an externally built Batch (raw hash-space keys, see
         io/batch.py) into this model's key space: apply the hot remap
@@ -336,10 +361,16 @@ class Trainer:
         profiling = False
         self._preempt_agreed = False
         last_cursor = (start_shard, start_offset)
-        for batch, shard_idx, resume in self._synced_batches(
+        stream = self._synced_batches(
             self.iter_train_batches(start_shard, start_offset),
             vote_preempt=True,
-        ):
+        )
+        # single-host: overlap host->device transfer with device compute
+        # (multi-host keeps put_batch on the voting thread — collective)
+        ahead = self.num_hosts == 1
+        if ahead:
+            stream = self._transfer_ahead(stream)
+        for batch, shard_idx, resume in stream:
             last_cursor = (shard_idx, resume)
             if (
                 cfg.profile_dir
@@ -350,7 +381,7 @@ class Trainer:
                 jax.profiler.start_trace(cfg.profile_dir)
                 profiling = True
                 profile_end = self._global_steps + cfg.profile_steps
-            arrays = self.step.put_batch(batch)
+            arrays = batch if ahead else self.step.put_batch(batch)
             self.state, metrics = self.step.train(self.state, arrays)
             steps += 1
             self._global_steps += 1
