@@ -1,0 +1,90 @@
+"""Hash-collision accounting for the dense-table design.
+
+The reference stores full 64-bit hashed keys collision-free in an
+unordered_map (/root/reference/src/optimizer/ftrl.h:84,151); this
+framework reduces keys mod table_size into a dense array, so distinct
+features can share a row.  This script measures what that costs on the
+bench dataset: for each table size, the fraction of distinct features
+— and of feature OCCURRENCES (what training actually sees) — that
+share a row with a different feature.
+
+Uses the CSR binary cache's full 64-bit keys (io/binary.py stores them
+unreduced precisely so this measurement and any future table size need
+no re-parse).
+
+Run: python scripts/collision_stats.py [--data PATH] ; one JSON line
+per table size — paste into docs/PERF.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def full_key_counts(csr_path: str) -> tuple[np.ndarray, np.ndarray]:
+    """(unique full keys, occurrence counts) over the whole shard."""
+    from xflow_tpu.io import binary
+
+    chunks = []
+    with open(csr_path, "rb") as f:
+        binary.read_header(f)
+        while True:
+            block = binary.read_record(f)
+            if block is None:
+                break
+            chunks.append(block.keys)
+    keys = np.concatenate(chunks)
+    return np.unique(keys, return_counts=True)
+
+
+def collision_stats(ukeys: np.ndarray, counts: np.ndarray, t: int) -> dict:
+    rows = ukeys.view(np.uint64) % np.uint64(t)
+    order = np.argsort(rows)
+    rows_sorted = rows[order]
+    counts_sorted = counts[order]
+    # a key collides iff its row equals a neighbor's in sorted order
+    same_prev = np.empty(len(rows_sorted), bool)
+    same_prev[0] = False
+    same_prev[1:] = rows_sorted[1:] == rows_sorted[:-1]
+    collides = same_prev.copy()
+    collides[:-1] |= same_prev[1:]
+    d = len(ukeys)
+    occ = counts.sum()
+    return {
+        "table_size_log2": int(np.log2(t)),
+        "distinct_keys": int(d),
+        "colliding_keys_frac": round(float(collides.sum()) / d, 6),
+        "colliding_occurrence_frac": round(
+            float(counts_sorted[collides].sum()) / float(occ), 6
+        ),
+        "occupied_rows": int(len(np.unique(rows))),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--data",
+        default="/tmp/xflow_bench/zipf-2000000-g1-s7-f39-v100000.ffm",
+    )
+    p.add_argument("--table-size-log2", type=int, nargs="*",
+                   default=[22, 24, 28])
+    args = p.parse_args()
+
+    csr = args.data + ".xfbc"
+    if not os.path.exists(csr):
+        from xflow_tpu.io import binary
+
+        binary.convert_shard(args.data, csr, block_mib=8)
+    ukeys, counts = full_key_counts(csr)
+    for log2 in args.table_size_log2:
+        print(json.dumps(collision_stats(ukeys, counts, 1 << log2)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,47 +1,103 @@
-"""Sweep hot-table size and dtype on the flagship bench workload.
+"""Sweep hot-table geometry (H, hot_nnz, cold_nnz) and hot dtype on the
+flagship LR+FTRL workload using REAL zipf-distributed batches from the
+bench dataset (not synthetic uniform keys): batches come off the CSR
+binary cache through the production ShardLoader with a measured
+frequency remap, exactly like training.
 
-Run: python scripts/probe_hot_sweep.py
+Run: python scripts/probe_hot_sweep.py [--iters N]
+Writes one JSON line per config; paste the table into docs/PERF.md.
 """
 
+import argparse
+import itertools
+import json
+import os
 import sys
+import time
 
 sys.path.insert(0, ".")
 
-import jax
-
-from bench import build, make_batches, run
+import bench
 from xflow_tpu.config import Config
+from xflow_tpu.io import freq
+
+T_LOG2 = 24
+BATCH = 131072
+NBATCH = 4
 
 
 def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+
+    import jax
+
     accel = [d for d in jax.devices() if d.platform != "cpu"]
+    data = bench.ensure_synth_data(
+        os.path.join("/tmp/xflow_bench", "zipf-2000000.ffm"), 2_000_000
+    )
+    csr = data + ".xfbc"
+    if not os.path.exists(csr):
+        from xflow_tpu.io import binary
+
+        binary.convert_shard(data, csr, block_mib=8)
+
+    # frequency stats once; per-H remaps derive from the same counts
+    counts = freq.count_keys([csr], None, 1 << T_LOG2, 64 << 20, 8 << 20)
+
     base = dict(
         model="lr",
         optimizer="ftrl",
-        table_size_log2=24,
-        batch_size=131072,
-        max_nnz=32,
-        hot_nnz=16,
+        table_size_log2=T_LOG2,
+        batch_size=BATCH,
         num_devices=1,
     )
-    configs = [("off", Config(**{**base, "max_nnz": 40, "hot_nnz": 24}))]
-    for log2, dt in (
-        (12, "float32"),
-        (12, "bfloat16"),
-        (14, "float32"),
-        (14, "bfloat16"),
+    sweeps = [("off", dict(max_nnz=40), None)]
+    for h_log2, (hot_nnz, cold), dt in itertools.product(
+        (12, 13, 14, 15, 16),
+        ((16, 32), (24, 16), (32, 12)),
+        ("float32", "bfloat16"),
     ):
-        configs.append(
+        sweeps.append(
             (
-                f"H=2^{log2} {dt}",
-                Config(**{**base, "hot_size_log2": log2, "hot_dtype": dt}),
+                f"H=2^{h_log2} kh={hot_nnz} kc={cold} {dt}",
+                dict(
+                    max_nnz=cold,
+                    hot_size_log2=h_log2,
+                    hot_nnz=hot_nnz,
+                    hot_dtype=dt,
+                ),
+                h_log2,
             )
         )
-    for name, cfg in configs:
-        step, state = build(accel, cfg)
-        batches, _ = make_batches(cfg, 2)
-        _, eps = run(step, state, batches, iters=10, warmup=2)
-        print(f"{name:18s} {eps/1e6:6.3f} M ex/s", flush=True)
+
+    remaps = {}
+    for name, kw, h_log2 in sweeps:
+        cfg = Config(**{**base, **kw})
+        remap = None
+        mass = None
+        if h_log2:
+            if h_log2 not in remaps:
+                remaps[h_log2] = freq.build_remap(counts, 1 << h_log2)
+            remap = remaps[h_log2]
+            mass = freq.hot_mass(counts, remap, 1 << h_log2)
+        batches, trunc = bench.real_batches(cfg, csr, remap, NBATCH)
+        step, state = bench.build(accel, cfg)
+        t0 = time.time()
+        _, eps = bench.run(step, state, batches, iters=args.iters)
+        print(
+            json.dumps(
+                {
+                    "config": name,
+                    "examples_per_sec": round(eps, 0),
+                    "truncated_frac": round(trunc, 5),
+                    "hot_mass": None if mass is None else round(mass, 4),
+                    "compile_plus_run_secs": round(time.time() - t0, 1),
+                }
+            ),
+            flush=True,
+        )
 
 
 if __name__ == "__main__":

@@ -172,6 +172,32 @@ def test_full_key_parse_mode():
     )
 
 
+def test_binary_misaligned_resume_rejected(converted):
+    """A byte offset that is not a record boundary (e.g. a cursor saved
+    against the TEXT version of the shard) must raise, not read garbage
+    record sizes."""
+    _, dst, _ = converted
+    loader = make_loader(dst)
+    good = list(loader.iter_batches())
+    _, resume = good[0]
+    with pytest.raises(ValueError, match="record boundary|shard end"):
+        list(loader.iter_batches(start_offset=resume + 3))
+
+
+def test_freq_count_rejects_packed(toy_dataset, tmp_path):
+    """Packed caches hold post-remap keys — frequency counting must
+    refuse them loudly instead of parsing binary bytes as text."""
+    from xflow_tpu.io import freq, packed
+
+    src = toy_dataset.train_prefix + "-00000"
+    dst = str(tmp_path / "pk-00000")
+    packed.convert_shard(
+        src, dst, batch_size=64, max_nnz=24, table_size=1 << 14
+    )
+    with pytest.raises(ValueError, match="packed-batch cache"):
+        freq.count_keys([dst], None, 1 << 14, 1 << 20)
+
+
 def test_python_pack_rejects_wide_keys():
     """The pure-Python pack fallback must reject keys outside int32 just
     like the native path (parser.cc returns -2) — never silently wrap.

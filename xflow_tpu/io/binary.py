@@ -139,6 +139,30 @@ def iter_blocks(
             f"loader expects {expect_hash_seed}"
         )
     offset = max(int(start_offset), data_start)
+    if offset > data_start:
+        # Records are variable-size, so validate the resume offset by
+        # hopping record headers from the start (16 bytes read per
+        # record — trivial at multi-MiB records).  A misaligned offset
+        # (e.g. a cursor saved against the TEXT version of this shard)
+        # would otherwise read garbage sizes; the packed format rejects
+        # this with modulo arithmetic, this format by walking.
+        pos = data_start
+        while pos < offset:
+            f.seek(pos)
+            hdr = f.read(_REC_HDR.size)
+            if len(hdr) != _REC_HDR.size:
+                raise ValueError(
+                    f"start_offset {start_offset} is past the shard end"
+                )
+            n, nnz = _REC_HDR.unpack(hdr)
+            # labels f32[n] + row_ptr i64[n+1] + keys i64 + slots i32
+            # + vals f32 (see _write_record)
+            pos += _REC_HDR.size + 4 * n + 8 * (n + 1) + 16 * nnz
+        if pos != offset:
+            raise ValueError(
+                f"start_offset {start_offset} is not a record boundary "
+                "(cursor from a different file/format?)"
+            )
     f.seek(offset)
     hash_mode = bool(meta["hash_mode"])
     while True:

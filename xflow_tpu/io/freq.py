@@ -45,7 +45,8 @@ def count_keys(
         if remaining <= 0:
             break
         with open(path, "rb") as f:
-            if f.read(len(binary.MAGIC)) == binary.MAGIC:
+            magic = f.read(len(binary.MAGIC))
+            if magic == binary.MAGIC:
                 # binary block cache: records already hold keys
                 for block, off, noff in binary.iter_blocks(f, table_size):
                     if len(block.keys):
@@ -54,6 +55,18 @@ def count_keys(
                     if remaining <= 0:
                         break
                 continue
+            from xflow_tpu.io import packed
+
+            if magic == packed.MAGIC:
+                # packed caches hold POST-remap keys — counting them
+                # cannot build a remap; parsing them as text would
+                # silently produce garbage counts
+                raise ValueError(
+                    f"{path} is a packed-batch cache: key frequencies "
+                    "must be counted from text or CSR-binary shards "
+                    "(the remap is baked in at pack time — point "
+                    "hot-table runs at the remap.npy used to build it)"
+                )
             f.seek(0)
             for raw in BlockReader(f, block_bytes):
                 block = parse_fn(raw)
