@@ -195,11 +195,18 @@ def real_batches(cfg, csr_path: str, remap, num: int):
     kept = 0.0
     real = 0
     for batch, _ in loader.iter_batches():
+        if batch.num_real() < cfg.batch_size:
+            break  # partial tail batch would inflate run()'s eps
         kept += float(batch.mask.sum() + batch.hot_mask.sum())
         real += batch.num_real()
         batches.append(batch)
         if len(batches) == num:
             break
+    if len(batches) < num:
+        raise ValueError(
+            f"{csr_path}: only {len(batches)} full batches of "
+            f"{cfg.batch_size} available, need {num}"
+        )
     truncated = 1.0 - kept / (real * 39.0)  # generator: 39 features/row
     return batches, truncated
 
@@ -445,8 +452,11 @@ def main() -> None:
     )
     data_path = csr = remap = None
     try:
+        if n_examples <= 0:
+            raise ValueError("XFLOW_BENCH_E2E_EXAMPLES=0: real data off")
         data_path, csr, remap, hot_mass = prepare_real_data(cfg, n_examples)
-        batches, truncated_frac = real_batches(cfg, csr, remap, 4)
+        nb = max(1, min(4, n_examples // cfg.batch_size))
+        batches, truncated_frac = real_batches(cfg, csr, remap, nb)
         result["batch_source"] = "zipf-cache"
         if hot_mass is not None:
             result["hot_mass"] = round(hot_mass, 4)

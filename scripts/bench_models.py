@@ -16,29 +16,37 @@ import time
 
 sys.path.insert(0, ".")  # repo root
 
-from bench import build, make_batches, probe_accelerator  # noqa: E402
+from bench import (  # noqa: E402
+    build,
+    make_batches,
+    prepare_real_data,
+    probe_accelerator,
+    real_batches,
+)
 
 
 def model_cfgs(base_b: int, accel: bool):
     """(name, Config) per family.  FM/MVM: v_dim=10 (ftrl.h:16).  FFM:
-    Avazu-style 24 fields, D=4 (BASELINE.json target config).  Sizes
+    per-field latent D=4.  max_fields=39 everywhere — the bench data is
+    Criteo-shaped with fgids 0..38 (gen_synth.FIELDS); a smaller cap
+    would silently mask fields out of the field-aware models.  Sizes
     shrink on the CPU fallback to keep runtime bounded."""
     from xflow_tpu.config import Config
 
     t = 24 if accel else 20
     b = base_b if accel else min(base_b, 16384)
     common = dict(
-        optimizer="ftrl", table_size_log2=t, batch_size=b, num_devices=1
+        optimizer="ftrl", table_size_log2=t, batch_size=b, num_devices=1,
+        max_fields=39,
     )
     return [
-        ("lr", Config(model="lr", max_nnz=32, hot_size_log2=12,
-                      hot_nnz=16, **common)),
+        # flagship geometry (docs/PERF.md round-4 sweep)
+        ("lr", Config(model="lr", max_nnz=16, hot_size_log2=12,
+                      hot_nnz=32, **common)),
         ("lr_nohot", Config(model="lr", max_nnz=40, **common)),
         ("fm", Config(model="fm", max_nnz=40, v_dim=10, **common)),
-        ("mvm", Config(model="mvm", max_nnz=40, v_dim=10, max_fields=40,
-                       **common)),
-        ("ffm", Config(model="ffm", max_nnz=24, ffm_v_dim=4,
-                       max_fields=24, **common)),
+        ("mvm", Config(model="mvm", max_nnz=40, v_dim=10, **common)),
+        ("ffm", Config(model="ffm", max_nnz=40, ffm_v_dim=4, **common)),
         ("wide_deep", Config(model="wide_deep", max_nnz=40, emb_dim=8,
                              hidden_dim=64, **common)),
     ]
@@ -49,6 +57,10 @@ def main() -> None:
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--batch-log2", type=int, default=16)  # 65536
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument(
+        "--synthetic", action="store_true",
+        help="use synthetic batches instead of the zipf CSR cache",
+    )
     args = ap.parse_args()
 
     backend = None if args.cpu else probe_accelerator()
@@ -62,12 +74,36 @@ def main() -> None:
     accel = backend is not None
     iters = args.iters if accel else max(2, args.iters // 3)
 
-    for name, cfg in model_cfgs(1 << args.batch_log2, accel):
+    cfgs = model_cfgs(1 << args.batch_log2, accel)
+    csr = remap = None
+    if not args.synthetic:
+        # one shared real-data prep; the remap is computed at the lr
+        # flagship's hot geometry (other models run hot-off).  Any prep
+        # failure degrades to synthetic batches — same policy as
+        # bench.py main(); each model still reports.
+        try:
+            _, csr, remap, _ = prepare_real_data(
+                cfgs[0][1], 2_000_000 if accel else 200_000
+            )
+        except Exception as e:
+            print(
+                json.dumps(
+                    {"real_data_error": f"{type(e).__name__}: {e}"}
+                ),
+                flush=True,
+            )
+
+    for name, cfg in cfgs:
         try:
             from bench import run
 
             step, state = build(devices, cfg)
-            batches, _ = make_batches(cfg, 2)
+            if csr is not None:
+                batches, _ = real_batches(
+                    cfg, csr, remap if cfg.hot_size else None, 2
+                )
+            else:
+                batches, _ = make_batches(cfg, 2)
             t0 = time.time()
             _, eps = run(step, state, batches, iters=iters, warmup=2)
             print(

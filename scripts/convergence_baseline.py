@@ -45,6 +45,15 @@ def run_model(model: str, epochs: int, batch_size: int) -> dict:
         max_nnz=40,
         max_fields=39,
         num_devices=1,
+        # Gradients are mean-over-batch (reference lr_worker.cc:116-118
+        # parity), so the batch size IS an optimizer hyperparameter:
+        # per-key updates scale as 1/B.  The reference's effective batch
+        # is a per-thread slice of a 2 MiB block — a few hundred rows —
+        # so convergence runs use a comparable small batch (measured:
+        # B=8192 reaches AUC 0.53 where B=512 reaches 0.65 on the same
+        # 500k examples).  Sparse update mode keeps small-batch steps
+        # O(B*nnz) instead of O(table).
+        update_mode="sparse",
         # optimizer defaults ARE the reference's ftrl.h:17-20 values
     )
     t = Trainer(cfg)
@@ -76,10 +85,20 @@ def run_model(model: str, epochs: int, batch_size: int) -> dict:
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--models", nargs="*", default=["lr", "fm", "mvm"])
-    p.add_argument("--epochs", type=int, default=8)
-    p.add_argument("--batch-size", type=int, default=8192)
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--batch-size", type=int, default=512)
     p.add_argument("--out", default="/tmp/xflow_conv/convergence.json")
+    p.add_argument(
+        "--platform",
+        help="force the JAX backend (e.g. cpu — convergence results are "
+        "device-independent; pin before any backend query or the "
+        "accelerator plugin hijacks selection)",
+    )
     args = p.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     results = {
         "dataset": "synthetic Criteo-shaped, 10M train / 1M test, "

@@ -5,19 +5,10 @@ part of any quality comparison (VERDICT round 3 item 7)."""
 
 import numpy as np
 
+import scripts.collision_stats as mod
+
 
 def test_collision_stats_crafted():
-    import importlib.util
-    import os
-
-    spec = importlib.util.spec_from_file_location(
-        "collision_stats",
-        os.path.join(os.path.dirname(__file__), "..", "scripts",
-                     "collision_stats.py"),
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-
     t = 8
     # keys 1 and 9 share row 1; keys 2, 10, 18 share row 2; 5 is alone
     ukeys = np.asarray([1, 9, 2, 10, 18, 5], np.int64)
@@ -36,17 +27,6 @@ def test_collision_stats_full_key_negative_int64():
     """Full murmur hashes stored as two's-complement int64 must reduce
     through uint64 arithmetic (row of a 'negative' key is still its
     unsigned hash mod T)."""
-    import importlib.util
-    import os
-
-    spec = importlib.util.spec_from_file_location(
-        "collision_stats",
-        os.path.join(os.path.dirname(__file__), "..", "scripts",
-                     "collision_stats.py"),
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-
     t = 16
     h = np.uint64(2**64 - 3)  # int64 view: -3; row must be (2^64-3) % 16
     ukeys = np.asarray([h], np.uint64).view(np.int64)
