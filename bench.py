@@ -463,6 +463,9 @@ def main() -> None:
     except Exception as e:
         result["real_data_error"] = f"{type(e).__name__}: {e}"
         result["batch_source"] = "synthetic"
+        # the CPU proxy must use the SAME batch source as the accel leg
+        # (best-vs-best on one dataset), so drop the cache wholesale
+        csr = remap = None
         batches, truncated_frac = make_batches(cfg, 4)
     result["hot_truncated_frac"] = round(truncated_frac, 6)
 

@@ -98,11 +98,17 @@ def main() -> None:
             from bench import run
 
             step, state = build(devices, cfg)
+            source = "synthetic"
+            batches = None
             if csr is not None:
-                batches, _ = real_batches(
-                    cfg, csr, remap if cfg.hot_size else None, 2
-                )
-            else:
+                try:
+                    batches, _ = real_batches(
+                        cfg, csr, remap if cfg.hot_size else None, 2
+                    )
+                    source = "zipf-cache"
+                except Exception:
+                    batches = None  # e.g. batch too large for the cache
+            if batches is None:
                 batches, _ = make_batches(cfg, 2)
             t0 = time.time()
             _, eps = run(step, state, batches, iters=iters, warmup=2)
@@ -114,6 +120,7 @@ def main() -> None:
                         "batch_size": cfg.batch_size,
                         "table_size_log2": cfg.table_size_log2,
                         "backend": backend or "cpu",
+                        "batch_source": source,
                         "wall_s": round(time.time() - t0, 1),
                     }
                 ),

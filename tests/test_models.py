@@ -112,7 +112,7 @@ def test_mvm_forward_oracle():
                     v[b, k, d] * x[b, k] for k in range(K) if slots[b, k] == s
                 )
                 prod *= 1.0 + ssum
-            total += prod
+            total += prod - 1.0  # centered form (models/mvm.py docstring)
         want[b] = total
     np.testing.assert_allclose(got, want, rtol=1e-4)
 
@@ -122,8 +122,8 @@ def test_mvm_ignores_out_of_range_fields():
     batch = random_batch(seed=10)
     batch["slots"] = jnp.full((B, K), 5, jnp.int32)  # all fields out of range
     v = jnp.asarray(np.random.default_rng(11).normal(size=(B, K, D)), jnp.float32)
-    # every slot empty → logit = sum_d prod_s 1 = D
-    np.testing.assert_allclose(np.asarray(model.logit({"v": v}, batch)), D)
+    # every slot empty → product 1 per factor, centered to logit 0
+    np.testing.assert_allclose(np.asarray(model.logit({"v": v}, batch)), 0.0)
     np.testing.assert_array_equal(
         np.asarray(model.grad_logit({"v": v}, batch)["v"]), 0.0
     )
