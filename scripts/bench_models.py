@@ -100,32 +100,31 @@ def main() -> None:
             step, state = build(devices, cfg)
             source = "synthetic"
             batches = None
+            batch_err = None
             if csr is not None:
                 try:
                     batches, _ = real_batches(
                         cfg, csr, remap if cfg.hot_size else None, 2
                     )
                     source = "zipf-cache"
-                except Exception:
-                    batches = None  # e.g. batch too large for the cache
+                except Exception as e:  # e.g. batch too large for cache
+                    batch_err = f"{type(e).__name__}: {e}"
             if batches is None:
                 batches, _ = make_batches(cfg, 2)
             t0 = time.time()
             _, eps = run(step, state, batches, iters=iters, warmup=2)
-            print(
-                json.dumps(
-                    {
-                        "model": name,
-                        "examples_per_sec": round(eps, 1),
-                        "batch_size": cfg.batch_size,
-                        "table_size_log2": cfg.table_size_log2,
-                        "backend": backend or "cpu",
-                        "batch_source": source,
-                        "wall_s": round(time.time() - t0, 1),
-                    }
-                ),
-                flush=True,
-            )
+            row = {
+                "model": name,
+                "examples_per_sec": round(eps, 1),
+                "batch_size": cfg.batch_size,
+                "table_size_log2": cfg.table_size_log2,
+                "backend": backend or "cpu",
+                "batch_source": source,
+                "wall_s": round(time.time() - t0, 1),
+            }
+            if batch_err is not None:
+                row["real_batch_error"] = batch_err
+            print(json.dumps(row), flush=True)
         except Exception as e:
             print(
                 json.dumps({"model": name, "error": f"{type(e).__name__}: {e}"}),

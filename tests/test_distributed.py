@@ -27,6 +27,28 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _launch_pair(cmd, env, timeout=600, fail_msg="distributed run deadlocked"):
+    """Spawn both ranks of a 2-process job, wait with a deadlock
+    timeout (kill all on expiry), return (procs, stderr_texts)."""
+    procs = [
+        subprocess.Popen(
+            cmd + ["--process-id", str(pid)],
+            env=env, stderr=subprocess.PIPE, text=True, cwd=os.getcwd(),
+        )
+        for pid in range(2)
+    ]
+    errs = []
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(fail_msg)
+        errs.append(err)
+    return procs, errs
+
+
 @pytest.mark.parametrize("hot", [False, True])
 def test_two_process_training(toy_dataset, tmp_path, hot):
     port = _free_port()
@@ -58,28 +80,10 @@ def test_two_process_training(toy_dataset, tmp_path, hot):
         cmd += ["--checkpoint-dir", str(tmp_path / "ck")]
 
     def run_pair(extra):
-        procs = [
-            subprocess.Popen(
-                cmd + extra + ["--process-id", str(pid)],
-                env=env_base,
-                stderr=subprocess.PIPE,
-                text=True,
-                cwd=os.getcwd(),
-            )
-            for pid in range(2)
-        ]
-        errs = []
-        for p in procs:
-            try:
-                _, err = p.communicate(timeout=600)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                pytest.fail(
-                    "distributed training deadlocked (collective mismatch?)"
-                )
-            errs.append(err)
-        return procs, errs
+        return _launch_pair(
+            cmd + extra, env_base,
+            fail_msg="distributed training deadlocked (collective mismatch?)",
+        )
 
     procs, errs = run_pair([])
     assert procs[0].returncode == 0, errs[0]
@@ -96,6 +100,52 @@ def test_two_process_training(toy_dataset, tmp_path, hot):
         assert procs[0].returncode == 0, errs[0]
         assert procs[1].returncode == 0, errs[1]
         assert "resumed at" in errs[0]
+
+
+def test_two_process_training_packed_shards(toy_dataset, tmp_path):
+    """Multi-host training over PACKED-cache shards (io/packed.py): the
+    format sniffing, geometry validation, and per-host shard walk must
+    compose with the SPMD step-count voting exactly like text shards
+    (3 packed shards over 2 hosts = unequal split)."""
+    from xflow_tpu.io import packed
+
+    out = str(tmp_path / "pk")
+    for i in range(3):
+        packed.convert_shard(
+            toy_dataset.train_prefix + f"-{i:05d}",
+            f"{out}-{i:05d}",
+            batch_size=64,
+            max_nnz=24,
+            table_size=1 << 14,
+        )
+    port = _free_port()
+    env_base = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+    )
+    cmd = [
+        sys.executable, "-m", "xflow_tpu.train",
+        "--model", "lr",
+        "--train", out,
+        "--test", toy_dataset.test_prefix,
+        "--epochs", "3",
+        "--batch-size", "64",
+        "--table-size-log2", "14",
+        "--max-nnz", "24",
+        "--num-devices", "2",
+        "--platform", "cpu",
+        "--coordinator", f"localhost:{port}",
+        "--num-processes", "2",
+    ]
+    procs, errs = _launch_pair(
+        cmd, env_base,
+        fail_msg="packed-shard distributed training deadlocked",
+    )
+    assert procs[0].returncode == 0, errs[0]
+    assert procs[1].returncode == 0, errs[1]
+    assert "auc" in errs[0]
+    assert "tp = " in errs[0]
 
 
 def test_two_process_ckpt_mkdir_failure_raises_not_hangs(toy_dataset, tmp_path):
@@ -129,26 +179,11 @@ def test_two_process_ckpt_mkdir_failure_raises_not_hangs(toy_dataset, tmp_path):
         "--checkpoint-dir", str(blocker / "ck"),
         "--skip-eval",
     ]
-    procs = [
-        subprocess.Popen(
-            cmd + ["--process-id", str(pid)],
-            env=env_base, stderr=subprocess.PIPE, text=True,
-            cwd=os.getcwd(),
-        )
-        for pid in range(2)
-    ]
-    errs = []
-    for p in procs:
-        try:
-            _, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail(
-                "checkpoint mkdir failure deadlocked the job (pre-barrier "
-                "exception not voted through _all_ok?)"
-            )
-        errs.append(err)
+    procs, errs = _launch_pair(
+        cmd, env_base, timeout=300,
+        fail_msg="checkpoint mkdir failure deadlocked the job (pre-barrier "
+        "exception not voted through _all_ok?)",
+    )
     assert procs[0].returncode != 0, "process 0 should fail on mkdir"
     assert procs[1].returncode != 0, "process 1 should learn of the failure"
     assert "NotADirectoryError" in errs[0] or "FileExistsError" in errs[0]
@@ -191,23 +226,7 @@ def test_two_process_midepoch_cursor_resume(toy_dataset, tmp_path):
     def run_pair(extra, port):
         cmd2 = list(cmd)
         cmd2[cmd2.index("--coordinator") + 1] = f"localhost:{port}"
-        procs = [
-            subprocess.Popen(
-                cmd2 + extra + ["--process-id", str(pid)],
-                env=env_base, stderr=subprocess.PIPE, text=True,
-                cwd=os.getcwd(),
-            )
-            for pid in range(2)
-        ]
-        errs = []
-        for p in procs:
-            try:
-                _, err = p.communicate(timeout=600)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                pytest.fail("distributed run deadlocked")
-            errs.append(err)
+        procs, errs = _launch_pair(cmd2 + extra, env_base)
         assert procs[0].returncode == 0, errs[0]
         assert procs[1].returncode == 0, errs[1]
         return errs
