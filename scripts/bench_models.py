@@ -30,7 +30,19 @@ def model_cfgs(base_b: int, accel: bool):
     per-field latent D=4.  max_fields=39 everywhere — the bench data is
     Criteo-shaped with fgids 0..38 (gen_synth.FIELDS); a smaller cap
     would silently mask fields out of the field-aware models.  Sizes
-    shrink on the CPU fallback to keep runtime bounded."""
+    shrink on the CPU fallback to keep runtime bounded.
+
+    Hot geometries are the measured per-model optima (docs/PERF.md
+    round-4 sweeps).  The wide-row models (FM/MVM, D=10) profit from a
+    LARGER head than LR: their cold scatter costs ~106 ns/slice (any
+    D>1 hits XLA's slow multi-lane scatter path, scripts/probe_fm2.py)
+    vs ~15 ns for LR's scalars, so hiding more mass behind the MXU hot
+    path is worth the extra one-hot traffic.
+
+    FFM's table rows are max_fields*v_dim = 156 floats wide — at
+    T=2^24 the (param, n, z) triple would be ~31 GB; its natural
+    single-chip scale is T=2^21 (3.9 GB).  No hot table: h2*D = 9984
+    lanes would force tiny scan chunks through ops/hot.py."""
     from xflow_tpu.config import Config
 
     t = 24 if accel else 20
@@ -39,30 +51,26 @@ def model_cfgs(base_b: int, accel: bool):
         optimizer="ftrl", table_size_log2=t, batch_size=b, num_devices=1,
         max_fields=39,
     )
+    hot = dict(max_nnz=12, hot_size_log2=14, hot_nnz=32)
     return [
         # flagship geometry (docs/PERF.md round-4 sweep)
         ("lr", Config(model="lr", max_nnz=16, hot_size_log2=12,
                       hot_nnz=32, **common)),
         ("lr_nohot", Config(model="lr", max_nnz=40, **common)),
-        ("fm", Config(model="fm", max_nnz=40, v_dim=10, **common)),
-        ("mvm", Config(model="mvm", max_nnz=40, v_dim=10, **common)),
-        ("ffm", Config(model="ffm", max_nnz=40, ffm_v_dim=4, **common)),
+        ("fm", Config(model="fm", v_dim=10, **hot, **common)),
+        ("fm_nohot", Config(model="fm", max_nnz=40, v_dim=10, **common)),
+        ("mvm", Config(model="mvm", v_dim=10, **hot, **common)),
+        ("mvm_nohot", Config(model="mvm", max_nnz=40, v_dim=10, **common)),
+        ("ffm", Config(model="ffm", max_nnz=40, ffm_v_dim=4,
+                       **{**common, "table_size_log2": 21 if accel else 18,
+                          "batch_size": min(b, 32768)})),
         ("wide_deep", Config(model="wide_deep", max_nnz=40, emb_dim=8,
                              hidden_dim=64, **common)),
     ]
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--cpu", action="store_true")
-    ap.add_argument("--batch-log2", type=int, default=16)  # 65536
-    ap.add_argument("--iters", type=int, default=10)
-    ap.add_argument(
-        "--synthetic", action="store_true",
-        help="use synthetic batches instead of the zipf CSR cache",
-    )
-    args = ap.parse_args()
-
+def run_one(name: str, args) -> None:
+    """Bench a single model in THIS process (child mode)."""
     backend = None if args.cpu else probe_accelerator()
     import jax
 
@@ -74,60 +82,105 @@ def main() -> None:
     accel = backend is not None
     iters = args.iters if accel else max(2, args.iters // 3)
 
-    cfgs = model_cfgs(1 << args.batch_log2, accel)
+    cfg = dict(model_cfgs(1 << args.batch_log2, accel))[name]
     csr = remap = None
     if not args.synthetic:
-        # one shared real-data prep; the remap is computed at the lr
-        # flagship's hot geometry (other models run hot-off).  Any prep
-        # failure degrades to synthetic batches — same policy as
-        # bench.py main(); each model still reports.
         try:
             _, csr, remap, _ = prepare_real_data(
-                cfgs[0][1], 2_000_000 if accel else 200_000
+                cfg, 2_000_000 if accel else 200_000
             )
         except Exception as e:
             print(
-                json.dumps(
-                    {"real_data_error": f"{type(e).__name__}: {e}"}
-                ),
+                json.dumps({"real_data_error": f"{type(e).__name__}: {e}"}),
                 flush=True,
             )
+    try:
+        from bench import run
 
-    for name, cfg in cfgs:
-        try:
-            from bench import run
+        step, state = build(devices, cfg)
+        source = "synthetic"
+        batches = None
+        batch_err = None
+        if csr is not None:
+            try:
+                batches, _ = real_batches(
+                    cfg, csr, remap if cfg.hot_size else None, 2
+                )
+                source = "zipf-cache"
+            except Exception as e:  # e.g. batch too large for cache
+                batch_err = f"{type(e).__name__}: {e}"
+        if batches is None:
+            batches, _ = make_batches(cfg, 2)
+        t0 = time.time()
+        _, eps = run(step, state, batches, iters=iters, warmup=2)
+        row = {
+            "model": name,
+            "examples_per_sec": round(eps, 1),
+            "batch_size": cfg.batch_size,
+            "table_size_log2": cfg.table_size_log2,
+            "hot": f"2^{cfg.hot_size_log2}x{cfg.hot_nnz}+cold{cfg.max_nnz}"
+            if cfg.hot_size else "off",
+            "backend": backend or "cpu",
+            "batch_source": source,
+            "wall_s": round(time.time() - t0, 1),
+        }
+        if batch_err is not None:
+            row["real_batch_error"] = batch_err
+        print(json.dumps(row), flush=True)
+    except Exception as e:
+        print(
+            json.dumps({"model": name, "error": f"{type(e).__name__}: {e}"}),
+            flush=True,
+        )
 
-            step, state = build(devices, cfg)
-            source = "synthetic"
-            batches = None
-            batch_err = None
-            if csr is not None:
-                try:
-                    batches, _ = real_batches(
-                        cfg, csr, remap if cfg.hot_size else None, 2
-                    )
-                    source = "zipf-cache"
-                except Exception as e:  # e.g. batch too large for cache
-                    batch_err = f"{type(e).__name__}: {e}"
-            if batches is None:
-                batches, _ = make_batches(cfg, 2)
-            t0 = time.time()
-            _, eps = run(step, state, batches, iters=iters, warmup=2)
-            row = {
-                "model": name,
-                "examples_per_sec": round(eps, 1),
-                "batch_size": cfg.batch_size,
-                "table_size_log2": cfg.table_size_log2,
-                "backend": backend or "cpu",
-                "batch_source": source,
-                "wall_s": round(time.time() - t0, 1),
-            }
-            if batch_err is not None:
-                row["real_batch_error"] = batch_err
-            print(json.dumps(row), flush=True)
-        except Exception as e:
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--batch-log2", type=int, default=16)  # 65536
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument(
+        "--synthetic", action="store_true",
+        help="use synthetic batches instead of the zipf CSR cache",
+    )
+    ap.add_argument(
+        "--model", default=None,
+        help="bench ONE model inline (child mode); default: all models, "
+        "each in its own subprocess",
+    )
+    args = ap.parse_args()
+
+    if args.model is not None:
+        run_one(args.model, args)
+        return
+
+    # Parent mode: one subprocess per model.  Isolation matters — a
+    # model whose tables cannot fit (or that trips an OOM) must not
+    # poison the device heap/jit caches of the models after it, which
+    # is exactly what happened when all models shared one process
+    # (round-4 log: FFM's 31 GB table OOM'd, then wide_deep — fine in
+    # isolation — reported RESOURCE_EXHAUSTED too).
+    import subprocess
+
+    names = [n for n, _ in model_cfgs(1 << args.batch_log2, True)]
+    passthrough = []
+    if args.cpu:
+        passthrough.append("--cpu")
+    if args.synthetic:
+        passthrough.append("--synthetic")
+    passthrough += ["--batch-log2", str(args.batch_log2),
+                    "--iters", str(args.iters)]
+    for name in names:
+        proc = subprocess.run(
+            [sys.executable, __file__, "--model", name, *passthrough],
+            stdout=subprocess.PIPE, text=True,
+        )
+        out = proc.stdout.strip()
+        if out:
+            print(out, flush=True)
+        if proc.returncode != 0:
             print(
-                json.dumps({"model": name, "error": f"{type(e).__name__}: {e}"}),
+                json.dumps({"model": name, "error": f"exit {proc.returncode}"}),
                 flush=True,
             )
 

@@ -1,0 +1,111 @@
+"""Piecewise timing of the FM train step at bench shapes — find where
+the measured 762 ms/step (171 k ex/s at B=131072) goes.
+
+Run: python scripts/probe_fm.py
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, K, D = 131072, 40, 10
+T = 1 << 24
+ITERS = 10
+
+
+def timeit(name, fn, *args):
+    fn_j = jax.jit(fn)
+    out = fn_j(*args)
+    jax.tree.map(lambda a: a.block_until_ready(), out)
+    # device_get sync per docs/PERF.md (block_until_ready unreliable here)
+    leaf = jax.tree.leaves(out)[0]
+    jax.device_get(leaf.ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn_j(*args)
+    leaf = jax.tree.leaves(out)[0]
+    jax.device_get(leaf.ravel()[:1])
+    dt = (time.perf_counter() - t0) / ITERS
+    print(json.dumps({"op": name, "ms": round(dt * 1e3, 2)}), flush=True)
+    return out
+
+
+def main():
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    dev = accel[0]
+    rng = np.random.default_rng(0)
+    keys = jax.device_put(
+        rng.integers(0, T, (B, K)).astype(np.int32), dev
+    )
+    w = jax.device_put(jnp.zeros((T, 1), jnp.float32), dev)
+    v = jax.device_put(jnp.zeros((T, D), jnp.float32), dev)
+    n_v = jnp.zeros_like(v)
+    z_v = jnp.zeros_like(v)
+    gv = jax.device_put(
+        rng.standard_normal((B, K, D)).astype(np.float32), dev
+    )
+    gw = jax.device_put(
+        rng.standard_normal((B, K, 1)).astype(np.float32), dev
+    )
+
+    timeit("gather w [B,K,1]", lambda t, k: t[k], w, keys)
+    timeit("gather v [B,K,10]", lambda t, k: t[k], v, keys)
+    timeit(
+        "scatter-add w",
+        lambda t, k, g: jnp.zeros_like(t).at[k.reshape(-1)].add(
+            g.reshape(-1, 1), mode="drop"
+        ),
+        w, keys, gw,
+    )
+    timeit(
+        "scatter-add v [T,10]",
+        lambda t, k, g: jnp.zeros_like(t).at[k.reshape(-1)].add(
+            g.reshape(-1, D), mode="drop"
+        ),
+        v, keys, gv,
+    )
+
+    def ftrl_elem(w_, n_, z_, g_):
+        n2 = n_ + g_ * g_
+        sigma = (jnp.sqrt(n2) - jnp.sqrt(n_)) / 5e-2
+        z2 = z_ + g_ - sigma * w_
+        shrink = (jnp.sign(z2) * 5e-5 - z2) / ((1.0 + jnp.sqrt(n2)) / 5e-2 + 10.0)
+        w2 = jnp.where(jnp.abs(z2) <= 5e-5, 0.0, shrink)
+        return jnp.where(n2 == 0.0, w_, w2), n2, z2
+
+    gfull = jax.device_put(jnp.ones((T, D), jnp.float32), dev)
+    timeit("ftrl elementwise [T,10]", ftrl_elem, v, n_v, z_v, gfull)
+
+    def scatter_then_ftrl(v_, n_, z_, k, g):
+        gbuf = jnp.zeros_like(v_).at[k.reshape(-1)].add(
+            g.reshape(-1, D), mode="drop"
+        )
+        return ftrl_elem(v_, n_, z_, gbuf)
+
+    timeit("scatter+ftrl v (fused)", scatter_then_ftrl, v, n_v, z_v, keys, gv)
+
+    # full production FM step for cross-check
+    from bench import build, make_batches
+    from xflow_tpu.config import Config
+
+    cfg = Config(
+        model="fm", optimizer="ftrl", table_size_log2=24,
+        batch_size=B, max_nnz=K, v_dim=D, num_devices=1, max_fields=39,
+    )
+    step, state = build(accel, cfg)
+    batches, _ = make_batches(cfg, 2)
+    from bench import run
+
+    _, eps = run(step, state, batches, iters=ITERS, warmup=2)
+    print(json.dumps({"op": "full fm step", "examples_per_sec": round(eps)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

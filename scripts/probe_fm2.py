@@ -1,0 +1,109 @@
+"""Scatter/gather cost vs row width D on [T, D] tables — find the
+alignment geometry that fixes FM/MVM's 106 ns/slice scatter.
+
+Run: python scripts/probe_fm2.py
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, K = 131072, 40
+T = 1 << 24
+M = B * K
+ITERS = 5
+
+
+def timeit(name, fn, *args, extra=None):
+    fn_j = jax.jit(fn)
+    out = fn_j(*args)
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn_j(*args)
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])
+    dt = (time.perf_counter() - t0) / ITERS
+    row = {"op": name, "ms": round(dt * 1e3, 2),
+           "ns_per_slice": round(dt / M * 1e9, 1)}
+    if extra:
+        row.update(extra)
+    print(json.dumps(row), flush=True)
+    for leaf in jax.tree.leaves(out):
+        leaf.delete()
+    return None
+
+
+def main():
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    dev = accel[0]
+    rng = np.random.default_rng(0)
+    keys = jax.device_put(rng.integers(0, T, M).astype(np.int32), dev)
+
+    for d in (1, 2, 4, 8, 10, 16, 32):
+        tbl = jax.device_put(jnp.zeros((T, d), jnp.float32), dev)
+        g = jax.device_put(jnp.ones((M, d), jnp.float32), dev)
+        timeit(f"gather D={d}", lambda t, k: t[k], tbl, keys,
+               extra={"d": d})
+        timeit(
+            f"scatter-add D={d}",
+            lambda t, k, gg: jnp.zeros_like(t).at[k].add(gg, mode="drop"),
+            tbl, keys, g, extra={"d": d},
+        )
+        tbl.delete()
+        g.delete()
+
+    # existing-buffer scatter (no zeros_like): does the fresh-zero
+    # allocation matter?
+    d = 10
+    tbl = jax.device_put(jnp.zeros((T, d), jnp.float32), dev)
+    g = jax.device_put(jnp.ones((M, d), jnp.float32), dev)
+    timeit(
+        "scatter-add D=10 into donated table",
+        jax.jit(
+            lambda t, k, gg: t.at[k].add(gg, mode="drop"),
+            donate_argnums=0,
+        ),
+        tbl, keys, g,
+    )
+    tbl = jax.device_put(jnp.zeros((T, d), jnp.float32), dev)
+
+    # sort + segment-sum consolidation then row scatter: the sparse-mode
+    # shape. unique keys ~ U << M on zipf, but here uniform worst case.
+    def consolidated(t, k, gg):
+        order = jnp.argsort(k)
+        ks = k[order]
+        gs = gg[order]
+        seg_start = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]]
+        )
+        seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+        gsum = jax.ops.segment_sum(gs, seg_id, num_segments=M)
+        rep = jnp.where(seg_start, ks, T)
+        return jnp.zeros_like(t).at[rep].add(gsum[: rep.shape[0]], mode="drop")
+
+    timeit("sort+segsum+scatter D=10", consolidated, tbl, keys, g)
+    tbl.delete(); g.delete()
+
+    # flattened layout: [T*D] scalar rows, key -> base row, D scatters of
+    # [M] each? no — single scatter of M*D scalar slices
+    d = 10
+    tblf = jax.device_put(jnp.zeros((T * d,), jnp.float32), dev)
+    gf = jax.device_put(jnp.ones((M, d), jnp.float32), dev)
+
+    def flat_scatter(t, k, gg):
+        rows = (k[:, None] * d + jnp.arange(d)[None, :]).reshape(-1)
+        return jnp.zeros_like(t).at[rows].add(gg.reshape(-1), mode="drop")
+
+    timeit("flat [T*10] scalar scatter (M*D slices)", flat_scatter,
+           tblf, keys, gf)
+    tblf.delete(); gf.delete()
+
+
+if __name__ == "__main__":
+    main()

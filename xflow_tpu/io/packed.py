@@ -170,7 +170,16 @@ def iter_batches(
 ) -> Iterator[tuple[Batch, int, int]]:
     """Yield (batch, offset, next_offset).  Batch arrays are read-only
     zero-copy views of each record's buffer — the whole point of this
-    format; copy before mutating."""
+    format; copy before mutating.
+
+    Records are mmap-backed: a consumer that only touches some fields
+    (the compact wire reads keys/mask/labels and skips vals/slots —
+    half the record) never pages the rest in, which roughly doubles the
+    measured host feed rate over the old read()-a-record path.  The
+    mmap outlives ``f`` (numpy views hold it via .base), so batches may
+    be used after the file is closed."""
+    import mmap
+
     f.seek(0)
     meta, data_start = read_header(f)
     fields, rec_size = _layout(meta)
@@ -179,6 +188,31 @@ def iter_batches(
         raise ValueError(
             f"start_offset {start_offset} is not a record boundary"
         )
+    try:
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        if hasattr(mmap, "MADV_SEQUENTIAL"):
+            mm.madvise(mmap.MADV_SEQUENTIAL)
+    except (ValueError, OSError):
+        mm = None  # unmmapable stream (pipe, empty file): read() path
+
+    def record(buf, base):
+        pos = base
+        kw = {}
+        for name, shape, dtype in fields:
+            kw[name] = np.frombuffer(
+                buf, dtype, count=int(np.prod(shape)), offset=pos
+            ).reshape(shape)
+            pos += int(np.prod(shape)) * dtype.itemsize
+        return Batch(**kw)
+
+    if mm is not None:
+        end = len(mm)
+        while offset + rec_size <= end:
+            yield record(mm, offset), offset, offset + rec_size
+            offset += rec_size
+        if offset != end:
+            raise ValueError("truncated packed shard record")
+        return
     f.seek(offset)
     while True:
         buf = f.read(rec_size)
@@ -186,17 +220,8 @@ def iter_batches(
             return
         if len(buf) != rec_size:
             raise ValueError("truncated packed shard record")
-        pos = 0
-        kw = {}
-        for name, shape, dtype in fields:
-            nbytes = int(np.prod(shape)) * dtype.itemsize
-            kw[name] = np.frombuffer(
-                buf, dtype, count=int(np.prod(shape)), offset=pos
-            ).reshape(shape)
-            pos += nbytes
-        next_offset = offset + rec_size
-        yield Batch(**kw), offset, next_offset
-        offset = next_offset
+        yield record(buf, 0), offset, offset + rec_size
+        offset += rec_size
 
 
 def shard_example_count(path: str) -> int:

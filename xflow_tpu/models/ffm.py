@@ -69,7 +69,6 @@ class FFMModel(AutodiffModel):
         x = batch["vals"] * batch["mask"]  # [B, K]
         linear = jnp.sum(rows["w"][..., 0] * x, axis=-1)
 
-        v = rows["v"].reshape(b, k, f, d)  # per-key field-specific vectors
         valid = (
             (batch["slots"] >= 0) & (batch["slots"] < f) & (batch["mask"] > 0)
         )  # [B, K] — negative field ids dropped, matching MVM/Wide&Deep
@@ -79,20 +78,33 @@ class FFMModel(AutodiffModel):
         onehot = (
             (slot[:, :, None] == jnp.arange(f)[None, None, :])
             & valid[:, :, None]
-        ).astype(v.dtype)  # [B, K, F]
+        ).astype(rows["v"].dtype)  # [B, K, F]
 
-        # field-aggregated sums: S[b, f1, f2, :] — one batch matmul
-        # contracting K (MXU path), no [B, K, K, *] pair tensors
-        vx = v * x_eff[:, :, None, None]  # [B, K, F, D]
-        s = jnp.einsum("bkf,bkgd->bfgd", onehot, vx)  # [B, F, F, D]
-        cross = jnp.einsum("bfgd,bgfd->b", s, s)
-        # subtract the i == i diagonal: x_i^2 * ||v[k_i, f_i, :]||^2
-        v_self = jnp.take_along_axis(
-            v, slot[:, :, None, None].astype(jnp.int32), axis=2
-        )[:, :, 0, :]  # [B, K, D]
-        diag = jnp.sum(
-            jnp.sum(v_self * v_self, axis=-1) * x_eff * x_eff, axis=-1
+        # TPU layout constraint: every materialized tensor keeps the
+        # flattened E = F*D as its minor dimension.  A [.., D=4]-minor
+        # operand gets T(8,128) lane padding — 32x physical memory; the
+        # first shape of this model OOM'd a 16 GB chip at B=32768 with
+        # a 26 GB copy of the [B,K,F,D] pair operand (round-4 log).
+        vx = rows["v"] * x_eff[:, :, None]  # [B, K, E]
+        # field-aggregated sums: one batch matmul contracting K (MXU);
+        # operand minor dims are F (padded 39->128 one-hot) and E=156
+        # (->256) — no 32x blowup, no [B, K, K, *] pair tensors
+        s = jnp.einsum("bkf,bke->bfe", onehot, vx)  # [B, F, E]
+
+        # cross term sum_{f1,f2,d} S[b,f1,f2,d] * S[b,f2,f1,d]: the
+        # (f1<->f2, d fixed) transpose + multiply + reduce stays an
+        # elementwise fusion over s read twice — never a dot_general,
+        # whose operand copies would resurrect the D-minor layout
+        s4 = s.reshape(b, f, f, d)
+        cross = jnp.sum(
+            s4 * jnp.transpose(s4, (0, 2, 1, 3)), axis=(1, 2, 3)
         )
+        # subtract the i == i diagonal: x_i^2 * ||v[k_i, f_i, :]||^2.
+        # Select each key's own-field block of E elementwise (e//D ==
+        # slot) instead of take_along_axis — same fusion argument.
+        eslot = (jnp.arange(f * d) // d).astype(slot.dtype)  # [E]
+        emask = eslot[None, None, :] == slot[:, :, None]  # [B, K, E]
+        diag = jnp.sum(jnp.where(emask, vx * vx, 0.0), axis=(1, 2))
         return linear + 0.5 * (cross - diag)
 
     def logit_pairwise(
