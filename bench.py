@@ -357,18 +357,16 @@ def bench_e2e(devices, cfg, data_path: str, result: dict, remap=None) -> None:
     )
     # host-only read rate (epoch-2+ feed capacity, no device).  Records
     # are mmap-backed views, so an untouched field costs nothing; to
-    # keep the metric honest this loop does the numpy half of
-    # batch_to_compact — exactly the fields and casts the training
-    # feed performs per batch.
+    # keep the metric honest this loop runs the numpy half of the
+    # compact wire — by construction exactly the per-batch work the
+    # training feed performs (parallel/step.py::compact_wire_np).
+    from xflow_tpu.parallel.step import compact_wire_np
+
     t0 = time.perf_counter()
     n = 0
     for batch, _ in pk_loader.iter_batches():
-        np.where(batch.mask > 0, batch.keys, np.int32(-1)).astype(np.int32)
-        np.where(
-            batch.hot_mask > 0, batch.hot_keys, np.int32(-1)
-        ).astype(np.int32)
-        batch.labels.astype(np.uint8)
-        n += int(batch.weights.astype(np.uint8).sum())
+        wire = compact_wire_np(batch)
+        n += int(wire["weights_u8"].sum())
     dt = time.perf_counter() - t0
     result["packed_read_examples_per_sec"] = round(n / dt, 1)
     # e2e with transfer-ahead (trainer._transfer_ahead structure): the

@@ -53,10 +53,49 @@ def test_dense_equals_sparse_sgd(toy_dataset):
     np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
 
 
-def test_dense_sharded_matches_single(toy_dataset):
+@pytest.mark.parametrize(
+    "model,kw",
+    [
+        ("lr", {}),
+        ("fm", {}),
+        ("ffm", {"ffm_v_dim": 2}),
+        ("wide_deep", {"emb_dim": 4, "hidden_dim": 8}),
+        # hot table + microbatch compose: hot sections split per slice
+        ("lr", {"hot_size_log2": 8, "hot_nnz": 8}),
+    ],
+)
+def test_microbatch_equals_full_batch(toy_dataset, model, kw):
+    """Gradient accumulation (Config.microbatch) is the same optimizer
+    step as the single-pass dense path — grads are pre-divided by the
+    full batch's real count, accumulated, then applied once."""
+    t1 = Trainer(cfg_for(toy_dataset, "dense", model, **kw))
+    t1.train()
+    t4 = Trainer(cfg_for(toy_dataset, "dense", model, microbatch=4, **kw))
+    t4.train()
+    for name in t1.state["tables"]:
+        for part in t1.state["tables"][name]:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(t1.state["tables"][name][part])),
+                np.asarray(jax.device_get(t4.state["tables"][name][part])),
+                rtol=1e-5,
+                atol=1e-7,
+                err_msg=f"{model}:{name}/{part}",
+            )
+    for key in t1.state["dense"]:
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(t1.state["dense"][key])),
+            np.asarray(jax.device_get(t4.state["dense"][key])),
+            rtol=1e-5,
+            atol=1e-6,
+            err_msg=f"{model}:dense/{key}",
+        )
+
+
+@pytest.mark.parametrize("mb", [1, 4])
+def test_dense_sharded_matches_single(toy_dataset, mb):
     t1 = Trainer(cfg_for(toy_dataset, "dense", num_devices=1))
     t1.train()
-    t8 = Trainer(cfg_for(toy_dataset, "dense", num_devices=8))
+    t8 = Trainer(cfg_for(toy_dataset, "dense", num_devices=8, microbatch=mb))
     t8.train()
     np.testing.assert_allclose(
         np.asarray(jax.device_get(t1.state["tables"]["w"]["param"])),

@@ -142,6 +142,17 @@ class Config:
     # Both paths produce identical results (tests/test_update_modes.py).
     update_mode: str = "dense"
 
+    # Gradient-accumulation slices per train step (1 = off).  The batch
+    # is split into `microbatch` equal slices scanned sequentially;
+    # per-slice gradients accumulate into the dense per-table buffers
+    # and ONE optimizer update runs at the end — numerically the same
+    # step as microbatch=1 (scatter-add order aside), but every
+    # [batch, nnz, D]-shaped intermediate shrinks by the slice count.
+    # This is the memory lever for wide-row models (FFM's pair tensors,
+    # docs/PERF.md layout section): big B on a small chip.  Requires
+    # update_mode="dense" and microbatch | batch_size.
+    microbatch: int = 1
+
     # -- hot table (frequency-partitioned head; docs/PERF.md "The win") --
     # log2 of the hot-table row count H (0 = off).  CTR key distributions
     # are zipfian; the top-H keys by frequency are permuted into table
@@ -189,6 +200,16 @@ class Config:
             raise ValueError(f"unknown update_mode {self.update_mode!r}")
         if not 10 <= self.table_size_log2 <= 30:
             raise ValueError("table_size_log2 must be in [10, 30]")
+        if self.microbatch < 1:
+            raise ValueError("microbatch must be >= 1")
+        if self.microbatch > 1:
+            if self.update_mode != "dense":
+                raise ValueError("microbatch requires update_mode='dense'")
+            if self.batch_size % self.microbatch:
+                raise ValueError(
+                    f"microbatch {self.microbatch} must divide "
+                    f"batch_size {self.batch_size}"
+                )
         if self.hot_size_log2:
             if self.update_mode != "dense":
                 raise ValueError("hot table requires update_mode='dense'")

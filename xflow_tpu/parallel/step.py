@@ -111,30 +111,35 @@ def validate_compact_batch(batch: Batch) -> None:
             )
 
 
+def compact_wire_np(batch: Batch) -> dict:
+    """The numpy (host) half of the compact wire: sentinel-coded int32
+    keys + uint8 labels/weights.  Shared by batch_to_compact and the
+    bench's host-feed measurement so the measured per-batch work is by
+    construction exactly the work the training feed performs."""
+    import numpy as np
+
+    def sentinel(keys, mask):
+        return np.where(mask > 0, keys, np.int32(-1)).astype(np.int32)
+
+    out = {
+        "ckeys": sentinel(batch.keys, batch.mask),
+        "labels_u8": batch.labels.astype(np.uint8),
+        "weights_u8": batch.weights.astype(np.uint8),
+    }
+    if batch.hot_nnz:
+        out["hot_ckeys"] = sentinel(batch.hot_keys, batch.hot_mask)
+    return out
+
+
 def batch_to_compact(batch: Batch, check: bool = True) -> BatchArrays:
     """Compact wire (Config.wire_mode): sentinel-coded keys + uint8
     labels/weights — ~16x fewer bytes/entry than the full format.
     Only valid when vals are identically 1 for real entries (hash mode)
     and the model never reads slots; _expand_wire reconstructs
     vals/mask/slots on device."""
-    import numpy as np
-
     if check:
         validate_compact_batch(batch)
-
-    def sentinel(keys, mask):
-        return jnp.asarray(
-            np.where(mask > 0, keys, np.int32(-1)).astype(np.int32)
-        )
-
-    out = {
-        "ckeys": sentinel(batch.keys, batch.mask),
-        "labels_u8": jnp.asarray(batch.labels.astype(np.uint8)),
-        "weights_u8": jnp.asarray(batch.weights.astype(np.uint8)),
-    }
-    if batch.hot_nnz:
-        out["hot_ckeys"] = sentinel(batch.hot_keys, batch.hot_mask)
-    return out
+    return {k: jnp.asarray(v) for k, v in compact_wire_np(batch).items()}
 
 
 class TrainStep:
@@ -271,22 +276,26 @@ class TrainStep:
             return self.model.logit(rows, batch, dense)
         return self.model.logit(rows, batch)
 
-    def _train_impl(
-        self, state: State, batch: BatchArrays
-    ) -> tuple[State, dict[str, jax.Array]]:
-        cfg = self.cfg
-        batch = self._expand_wire(batch)
-        tables = state["tables"]
-        dense = state["dense"]
+    def _forward_grads(
+        self,
+        tables: dict,
+        dense: dict,
+        batch: BatchArrays,
+        num_real: jax.Array,
+    ):
+        """pctr + per-occurrence gradients for one (micro)batch.
+
+        Returns (pctr, occ_grads, grad_dense_or_None); occ_grads are
+        already residual-scaled and divided by the FULL batch's real
+        example count, so accumulating them across microbatch slices
+        reproduces the whole-batch mean-gradient semantics exactly
+        (lr_worker.cc:116-118)."""
         rows = self._gather_model_rows(tables, batch)
         mbatch = self._model_view(batch)
-        kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
-        num_real = jnp.maximum(jnp.sum(batch["weights"]), 1.0)
-        new_dense = dense
         if getattr(self.model, "autodiff", False):
-            # Autodiff path (FFM, wide&deep — no reference gradient quirks):
-            # stable BCE-with-logits; d/dlogit = sigmoid(logit) - y, the
-            # same residual semantics as the explicit path.
+            # Autodiff path (FFM, wide&deep — no reference gradient
+            # quirks): stable BCE-with-logits; d/dlogit = sigmoid - y,
+            # the same residual semantics as the explicit path.
             def loss_fn(rows_, dense_):
                 logit_ = self.model.logit(rows_, mbatch, dense_)
                 nll = jax.nn.softplus(logit_) - mbatch["labels"] * logit_
@@ -298,26 +307,33 @@ class TrainStep:
             (_, logit), (grad_rows, grad_dense) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True
             )(rows, dense)
-            pctr = sigmoid_ref(logit)
-            occ_grads = grad_rows  # already include residual and 1/num_real
-            if dense:
-                new_dense = jax.tree.map(
-                    lambda p, g: p - cfg.sgd_lr * g, dense, grad_dense
-                )
-        else:
-            logit = self.model.logit(rows, mbatch)
-            pctr = sigmoid_ref(logit)
-            # Residual "loss" exactly as the reference names it
-            # (lr_worker.cc:121-143): sigma(wx) - y, zeroed for pad
-            # examples, pre-divided by batch size for the mean-gradient
-            # semantics.
-            residual = (pctr - mbatch["labels"]) * mbatch["weights"] / num_real
-            grad_logit = self.model.grad_logit(rows, mbatch)
-            occ_grads = {
-                name: g * residual[:, None, None]
-                for name, g in grad_logit.items()
-            }
+            return sigmoid_ref(logit), grad_rows, (grad_dense or None)
+        logit = self.model.logit(rows, mbatch)
+        pctr = sigmoid_ref(logit)
+        # Residual "loss" exactly as the reference names it
+        # (lr_worker.cc:121-143): sigma(wx) - y, zeroed for pad
+        # examples, pre-divided by batch size for the mean-gradient
+        # semantics.
+        residual = (pctr - mbatch["labels"]) * mbatch["weights"] / num_real
+        grad_logit = self.model.grad_logit(rows, mbatch)
+        occ_grads = {
+            name: g * residual[:, None, None]
+            for name, g in grad_logit.items()
+        }
+        return pctr, occ_grads, None
 
+    def _scatter_grads(
+        self,
+        tables: dict,
+        batch: BatchArrays,
+        occ_grads: dict,
+        gbufs: dict,
+    ) -> dict:
+        """Accumulate per-occurrence grads into dense [T, D] buffers
+        (one per table): scatter-add for the cold section, two-level
+        one-hot MXU matmuls for the hot section (ops/hot.py)."""
+        cfg = self.cfg
+        kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
         sentinel = jnp.int32(cfg.table_size)
         keys_eff = jnp.where(
             batch["mask"] > 0, batch["keys"], sentinel
@@ -330,8 +346,7 @@ class TrainStep:
                 batch["hot_keys"],
                 jnp.int32(cfg.hot_size),
             ).reshape(-1)
-
-        new_tables = {}
+        out = {}
         for name, table in tables.items():
             d = table["param"].shape[-1]
             occ = occ_grads[name]
@@ -340,24 +355,43 @@ class TrainStep:
                 # buffer; cold grads keep the DMA scatter path.
                 hot_g = occ[:, :kh].reshape(-1, d)
                 occ = occ[:, kh:]
-            flat_g = occ.reshape(-1, d)
-            if cfg.update_mode == "dense":
-                # Scatter-add consolidates duplicate keys; the optimizer
-                # recurrence then runs elementwise over the full table —
-                # no sort, no row gather/scatter.  Untouched rows see g=0,
-                # for which FTRL/SGD are idempotent (optim docstrings).
-                gbuf = jnp.zeros_like(table["param"]).at[keys_eff].add(
-                    flat_g, mode="drop"
+            gbuf = gbufs[name].at[keys_eff].add(
+                occ.reshape(-1, d), mode="drop"
+            )
+            if kh:
+                ghot = hot_scatter(
+                    hot_keys_eff, hot_g, cfg.hot_size,
+                    dtype=self._hot_dtype,
                 )
-                if kh:
-                    ghot = hot_scatter(
-                        hot_keys_eff, hot_g, cfg.hot_size,
-                        dtype=self._hot_dtype,
-                    )
-                    gbuf = gbuf.at[: cfg.hot_size].add(ghot)
-                new_tables[name] = self.optimizer.update_rows(table, gbuf)
-            else:
-                ukeys, gsum = consolidate(keys_eff, flat_g, cfg.table_size)
+                gbuf = gbuf.at[: cfg.hot_size].add(ghot)
+            out[name] = gbuf
+        return out
+
+    def _train_impl(
+        self, state: State, batch: BatchArrays
+    ) -> tuple[State, dict[str, jax.Array]]:
+        cfg = self.cfg
+        batch = self._expand_wire(batch)
+        tables = state["tables"]
+        dense = state["dense"]
+        num_real = jnp.maximum(jnp.sum(batch["weights"]), 1.0)
+
+        if cfg.update_mode == "sparse":
+            pctr, occ_grads, _ = self._forward_grads(
+                tables, dense, batch, num_real
+            )
+            kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
+            assert not kh, "hot table requires dense mode (config checks)"
+            sentinel = jnp.int32(cfg.table_size)
+            keys_eff = jnp.where(
+                batch["mask"] > 0, batch["keys"], sentinel
+            ).reshape(-1)
+            new_tables = {}
+            for name, table in tables.items():
+                d = table["param"].shape[-1]
+                ukeys, gsum = consolidate(
+                    keys_eff, occ_grads[name].reshape(-1, d), cfg.table_size
+                )
                 state_rows = {
                     k: gather_rows(arr, ukeys) for k, arr in table.items()
                 }
@@ -366,17 +400,86 @@ class TrainStep:
                     k: scatter_rows(table[k], ukeys, new_rows[k])
                     for k in table.keys()
                 }
+            metrics = {
+                "logloss": logloss(batch["labels"], pctr, batch["weights"]),
+                "count": jnp.sum(batch["weights"]),
+            }
+            return {
+                "tables": new_tables,
+                "dense": dense,
+                "step": state["step"] + 1,
+            }, metrics
 
-        metrics = {
-            "logloss": logloss(batch["labels"], pctr, batch["weights"]),
-            "count": jnp.sum(batch["weights"]),
+        # -- dense mode: accumulate grads into per-table buffers, then
+        # ONE optimizer pass.  Scatter-add consolidates duplicate keys;
+        # the recurrence runs elementwise over the full table — no sort,
+        # no row gather/scatter.  Untouched rows see g=0, for which
+        # FTRL/SGD are idempotent (optim docstrings).
+        gbufs = {
+            name: jnp.zeros_like(t["param"]) for name, t in tables.items()
         }
-        new_state = {
+        s = cfg.microbatch
+        if s == 1:
+            pctr, occ_grads, grad_dense = self._forward_grads(
+                tables, dense, batch, num_real
+            )
+            gbufs = self._scatter_grads(tables, batch, occ_grads, gbufs)
+            ll = logloss(batch["labels"], pctr, batch["weights"])
+            cnt = jnp.sum(batch["weights"])
+        else:
+            # Gradient accumulation (Config.microbatch): scan over batch
+            # slices so every [B-slice, nnz, D] intermediate is 1/s the
+            # size.  Grads are pre-divided by the FULL batch num_real, so
+            # the accumulated buffers equal the single-pass ones.
+            xs = {
+                k: v.reshape((s, v.shape[0] // s) + v.shape[1:])
+                for k, v in batch.items()
+            }
+            gdense0 = jax.tree.map(jnp.zeros_like, dense)
+
+            def body(carry, bslice):
+                gbufs_c, gdense_c, nll_c, cnt_c = carry
+                pctr_s, occ_s, gd = self._forward_grads(
+                    tables, dense, bslice, num_real
+                )
+                gbufs_c = self._scatter_grads(
+                    tables, bslice, occ_s, gbufs_c
+                )
+                if gd is not None:
+                    gdense_c = jax.tree.map(
+                        lambda a, b: a + b, gdense_c, gd
+                    )
+                w = bslice["weights"]
+                nll_c = nll_c + logloss(
+                    bslice["labels"], pctr_s, w
+                ) * jnp.sum(w)
+                return (gbufs_c, gdense_c, nll_c, cnt_c + jnp.sum(w)), None
+
+            zero = jnp.zeros((), jnp.float32)
+            (gbufs, grad_dense, nll_sum, cnt), _ = jax.lax.scan(
+                body, (gbufs, gdense0, zero, zero), xs
+            )
+            if not dense:
+                grad_dense = None
+            ll = nll_sum / jnp.maximum(cnt, 1.0)
+
+        new_dense = dense
+        if dense and grad_dense is not None:
+            # dense MLP params take plain SGD regardless of the table
+            # optimizer (models/wide_deep.py rationale)
+            new_dense = jax.tree.map(
+                lambda p, g: p - cfg.sgd_lr * g, dense, grad_dense
+            )
+        new_tables = {
+            name: self.optimizer.update_rows(table, gbufs[name])
+            for name, table in tables.items()
+        }
+        metrics = {"logloss": ll, "count": cnt}
+        return {
             "tables": new_tables,
             "dense": new_dense,
             "step": state["step"] + 1,
-        }
-        return new_state, metrics
+        }, metrics
 
     def _predict_impl(self, state: State, batch: BatchArrays) -> jax.Array:
         """pctr per example (reference calculate_pctr, lr_worker.cc:46-61)."""

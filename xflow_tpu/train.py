@@ -51,6 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-nnz", type=int, dest="max_nnz")
     p.add_argument("--max-fields", type=int, dest="max_fields")
     p.add_argument("--block-mib", type=int, dest="block_mib")
+    p.add_argument(
+        "--microbatch", type=int, dest="microbatch",
+        help="gradient-accumulation slices per step (1 = off): same "
+        "optimizer step at 1/N the batch-shaped memory",
+    )
     p.add_argument("--alpha", type=float)
     p.add_argument("--beta", type=float)
     p.add_argument("--lambda1", type=float)
