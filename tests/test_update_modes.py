@@ -27,12 +27,14 @@ def cfg_for(ds, mode, model="lr", **kw):
 
 
 @pytest.mark.parametrize(
-    "model,table", [("lr", "w"), ("fm", "v"), ("mvm", "v")]
+    "model,table",
+    [("lr", "w"), ("fm", "v"), ("mvm", "v"), ("wide_deep", "emb")],
 )
 def test_dense_equals_sparse(toy_dataset, model, table):
-    td = Trainer(cfg_for(toy_dataset, "dense", model))
+    kw = {"emb_dim": 4, "hidden_dim": 8} if model == "wide_deep" else {}
+    td = Trainer(cfg_for(toy_dataset, "dense", model, **kw))
     td.train()
-    ts = Trainer(cfg_for(toy_dataset, "sparse", model))
+    ts = Trainer(cfg_for(toy_dataset, "sparse", model, **kw))
     ts.train()
     for name in td.state["tables"]:
         for part in td.state["tables"][name]:
@@ -41,6 +43,21 @@ def test_dense_equals_sparse(toy_dataset, model, table):
             np.testing.assert_allclose(
                 a, b, rtol=1e-5, atol=1e-7, err_msg=f"{name}/{part}"
             )
+    # dense (MLP) params must train in BOTH modes — a refactor once
+    # dropped grad_dense on the sparse path and only the tables moved
+    if td.state["dense"]:
+        init_dense = Trainer(
+            cfg_for(toy_dataset, "dense", model, **kw)
+        ).state["dense"]
+        for key in td.state["dense"]:
+            a = np.asarray(jax.device_get(td.state["dense"][key]))
+            b = np.asarray(jax.device_get(ts.state["dense"][key]))
+            np.testing.assert_allclose(
+                a, b, rtol=1e-5, atol=1e-6, err_msg=f"dense/{key}"
+            )
+            assert not np.allclose(
+                a, np.asarray(jax.device_get(init_dense[key]))
+            ) or a.size <= 1, f"dense/{key} never updated"
 
 
 def test_dense_equals_sparse_sgd(toy_dataset):

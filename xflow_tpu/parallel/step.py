@@ -377,7 +377,7 @@ class TrainStep:
         num_real = jnp.maximum(jnp.sum(batch["weights"]), 1.0)
 
         if cfg.update_mode == "sparse":
-            pctr, occ_grads, _ = self._forward_grads(
+            pctr, occ_grads, grad_dense = self._forward_grads(
                 tables, dense, batch, num_real
             )
             kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
@@ -400,15 +400,11 @@ class TrainStep:
                     k: scatter_rows(table[k], ukeys, new_rows[k])
                     for k in table.keys()
                 }
-            metrics = {
-                "logloss": logloss(batch["labels"], pctr, batch["weights"]),
-                "count": jnp.sum(batch["weights"]),
-            }
-            return {
-                "tables": new_tables,
-                "dense": dense,
-                "step": state["step"] + 1,
-            }, metrics
+            ll = logloss(batch["labels"], pctr, batch["weights"])
+            cnt = jnp.sum(batch["weights"])
+            return self._finish_step(
+                state, new_tables, dense, grad_dense, ll, cnt
+            )
 
         # -- dense mode: accumulate grads into per-table buffers, then
         # ONE optimizer pass.  Scatter-add consolidates duplicate keys;
@@ -463,17 +459,24 @@ class TrainStep:
                 grad_dense = None
             ll = nll_sum / jnp.maximum(cnt, 1.0)
 
-        new_dense = dense
-        if dense and grad_dense is not None:
-            # dense MLP params take plain SGD regardless of the table
-            # optimizer (models/wide_deep.py rationale)
-            new_dense = jax.tree.map(
-                lambda p, g: p - cfg.sgd_lr * g, dense, grad_dense
-            )
         new_tables = {
             name: self.optimizer.update_rows(table, gbufs[name])
             for name, table in tables.items()
         }
+        return self._finish_step(
+            state, new_tables, dense, grad_dense, ll, cnt
+        )
+
+    def _finish_step(self, state, new_tables, dense, grad_dense, ll, cnt):
+        """Shared step tail for both update modes: dense (MLP) params
+        take plain SGD regardless of the table optimizer
+        (models/wide_deep.py rationale) — one copy of that rule, so
+        dense vs sparse mode cannot drift apart."""
+        new_dense = dense
+        if dense and grad_dense is not None:
+            new_dense = jax.tree.map(
+                lambda p, g: p - self.cfg.sgd_lr * g, dense, grad_dense
+            )
         metrics = {"logloss": ll, "count": cnt}
         return {
             "tables": new_tables,

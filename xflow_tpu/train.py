@@ -56,6 +56,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="gradient-accumulation slices per step (1 = off): same "
         "optimizer step at 1/N the batch-shaped memory",
     )
+    p.add_argument(
+        "--update-mode", dest="update_mode", choices=["dense", "sparse"],
+        help="dense: scatter-add + full-table optimizer pass (TPU-fast); "
+        "sparse: sort/consolidate + touched-rows-only update (small "
+        "batches, CPU)",
+    )
     p.add_argument("--alpha", type=float)
     p.add_argument("--beta", type=float)
     p.add_argument("--lambda1", type=float)
