@@ -1,10 +1,15 @@
 """Convergence baseline (SURVEY §6: "a first task of the new repo"):
-train LR / FM / MVM to convergence with the reference's exact FTRL
-hyperparameters (/root/reference/src/optimizer/ftrl.h:17-20 — α=5e-2,
-β=1, λ1=5e-5, λ2=10, v_dim=10) on a Criteo-shaped synthetic dataset
-with planted logistic signal (scripts/gen_synth.py; real Criteo is not
-available in this environment — documented proxy), and record per-epoch
-test logloss/AUC curves against the generator's Bayes-optimal floor.
+train any model family (lr / fm / mvm / ffm / wide_deep) to
+convergence with the reference's exact FTRL hyperparameters
+(/root/reference/src/optimizer/ftrl.h:17-20 — α=5e-2, β=1, λ1=5e-5,
+λ2=10, v_dim=10) on a Criteo-shaped synthetic dataset with planted
+logistic signal (scripts/gen_synth.py; real Criteo is not available in
+this environment — documented proxy), and record per-epoch test
+logloss/AUC curves against the generator's Bayes-optimal floor.
+
+The recorded docs/CONVERGENCE.md rows used: `--models lr --epochs 6`,
+`--models fm mvm --epochs 4`, `--models wide_deep --epochs 4`, and
+`--models ffm --epochs 2` (FFM's CPU step is ~10× the others').
 
 Dataset: 10M train / 1M test, 39 fields, zipf(1.2) ids, vocab 3.9M —
 generate with:
