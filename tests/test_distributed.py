@@ -72,8 +72,10 @@ def test_two_process_training(toy_dataset, tmp_path, hot):
         "--num-processes", "2",
     ]
     if hot:
+        # compose the hot-table MXU path AND the gradient-accumulation
+        # scan with real 2-process collectives in one parametrization
         cmd += ["--hot-size-log2", "8", "--hot-nnz", "8",
-                "--freq-sample-mib", "1"]
+                "--freq-sample-mib", "1", "--microbatch", "2"]
     else:
         # cover the multi-host checkpoint path (collective allgather
         # save, rank-0 writes) in one of the parametrizations
