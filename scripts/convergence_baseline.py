@@ -39,14 +39,16 @@ BAYES_LOGLOSS = 0.5106  # gen_synth.bayes_optimal_logloss(seed=7)
 BAYES_AUC = 0.7883
 
 
-def run_model(model: str, epochs: int, batch_size: int) -> dict:
+def run_model(
+    model: str, epochs: int, batch_size: int, table_size_log2: int = 24
+) -> dict:
     cfg = Config(
         model=model,
         train_path=TRAIN,
         test_path=TEST,
         epochs=epochs,
         batch_size=batch_size,
-        table_size_log2=24,
+        table_size_log2=table_size_log2,
         max_nnz=40,
         max_fields=39,
         num_devices=1,
@@ -81,6 +83,7 @@ def run_model(model: str, epochs: int, batch_size: int) -> dict:
         "model": model,
         "epochs": epochs,
         "batch_size": batch_size,
+        "table_size_log2": table_size_log2,
         "final_test_logloss": curve[-1]["test_logloss"],
         "final_test_auc": curve[-1]["test_auc"],
         "curve": curve,
@@ -92,6 +95,12 @@ def main():
     p.add_argument("--models", nargs="*", default=["lr", "fm", "mvm"])
     p.add_argument("--epochs", type=int, default=6)
     p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument(
+        "--table-size-log2", type=int, default=24,
+        help="2^24 carries ~12%% occurrence collisions on this dataset, "
+        "2^28 ~1%% (docs/PERF.md) — vary to quantify the collision cost "
+        "the reference's exact-key store doesn't pay",
+    )
     p.add_argument("--out", default="/tmp/xflow_conv/convergence.json")
     p.add_argument(
         "--platform",
@@ -115,7 +124,9 @@ def main():
     }
     for m in args.models:
         t0 = time.time()
-        r = run_model(m, args.epochs, args.batch_size)
+        r = run_model(
+            m, args.epochs, args.batch_size, args.table_size_log2
+        )
         r["wall_secs"] = round(time.time() - t0, 1)
         results["models"].append(r)
         with open(args.out, "w") as f:
