@@ -120,6 +120,8 @@ def main():
             batch_size=cfg.batch_size,
             max_nnz=16,
             table_size=cfg.table_size,
+            block_mib=8,
+            parse_fn=make_parse_fn(cfg.table_size, True, cfg.seed),
             hash_seed=cfg.seed,
             remap=remap,
             hot_size=1 << 12,

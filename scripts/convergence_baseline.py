@@ -8,7 +8,7 @@ this environment — documented proxy), and record per-epoch test
 logloss/AUC curves against the generator's Bayes-optimal floor.
 
 The recorded docs/CONVERGENCE.md rows used: `--models lr --epochs 6`,
-`--models fm mvm --epochs 4`, `--models wide_deep --epochs 4`, and
+`--models fm mvm --epochs 6`, `--models wide_deep --epochs 6`, and
 `--models ffm --epochs 2` (FFM's CPU step is ~10× the others').
 
 Dataset: 10M train / 1M test, 39 fields, zipf(1.2) ids, vocab 3.9M —

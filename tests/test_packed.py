@@ -86,6 +86,19 @@ def test_packed_resume_exact(packed_shard):
         assert fo == to
 
 
+def test_packed_stale_resume_cursor_rejected(packed_shard):
+    """A resume offset past EOF (checkpoint cursor against a cache
+    rebuilt shorter) fails with a clear message — like the CSR cache's
+    'past the shard end' — instead of silently dropping the shard
+    remainder or claiming a truncated record."""
+    _, dst, _ = packed_shard
+    loader = make_loader(dst)
+    full = list(loader.iter_batches())
+    rec_size = full[1][1] - full[0][1]  # record-aligned stride
+    with pytest.raises(ValueError, match="past the packed shard end"):
+        list(loader.iter_batches(start_offset=full[-1][1] + rec_size))
+
+
 def test_packed_cli_and_training_parity(toy_dataset, tmp_path):
     out = str(tmp_path / "pk")
     rc = packed.main([

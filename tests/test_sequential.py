@@ -1,0 +1,185 @@
+"""update_mode='sequential' is step-for-step the same training as a
+sequence of dense steps of batch_size/microbatch examples (the scan
+carries the tables; gradients divide by the slice's real count) —
+the property that lets one device dispatch compose with the proven
+small-batch FTRL convergence (config.update_mode docstring)."""
+
+import numpy as np
+import jax
+import pytest
+
+from xflow_tpu.config import Config
+from xflow_tpu.io.batch import make_batch
+from xflow_tpu.models import make_model
+from xflow_tpu.optim import make_optimizer
+from xflow_tpu.parallel.mesh import make_mesh
+from xflow_tpu.parallel.step import TrainStep, init_state
+
+B, M, K = 64, 4, 12  # superbatch, slice count, padded nnz
+
+
+def rand_batch(rng, b, hot_size=0, hot_nnz=0, table=1 << 12, fields=8):
+    keys = rng.integers(0, table, (b, K)).astype(np.int32)
+    slots = rng.integers(0, fields, (b, K)).astype(np.int32)
+    vals = rng.uniform(0.5, 1.5, (b, K)).astype(np.float32)
+    mask = (rng.uniform(size=(b, K)) < 0.8).astype(np.float32)
+    labels = (rng.uniform(size=b) < 0.4).astype(np.float32)
+    weights = np.ones(b, np.float32)
+    weights[-3:] = 0.0  # pad examples in the last slices
+    return keys, slots, vals, mask, labels, weights
+
+
+def slice_rows(arrs, j, m):
+    """Interleaved slice j (example i -> slice i % m), matching
+    parallel.step._interleaved_slices."""
+    return tuple(a[j::m] for a in arrs)
+
+
+def build(model, cfg):
+    mesh = make_mesh(cfg.num_devices)
+    mdl = make_model(cfg)
+    opt = make_optimizer(cfg)
+    step = TrainStep(mdl, opt, cfg, mesh)
+    return step, init_state(mdl, opt, cfg, mesh)
+
+
+def base_cfg(model, **kw):
+    d = dict(
+        model=model,
+        batch_size=B,
+        table_size_log2=12,
+        max_nnz=K,
+        max_fields=8,
+        num_devices=1,
+        wire_mode="full",
+        emb_dim=4,
+        hidden_dim=8,
+        ffm_v_dim=2,
+    )
+    d.update(kw)
+    return Config(**d)
+
+
+@pytest.mark.parametrize(
+    "model,kw",
+    [
+        ("lr", {}),
+        ("fm", {}),
+        ("mvm", {}),
+        ("ffm", {}),
+        ("wide_deep", {}),
+        ("lr", {"hot_size_log2": 8, "hot_nnz": 6}),
+        ("lr", {"optimizer": "sgd"}),
+    ],
+)
+def test_sequential_equals_dense_sequence(model, kw):
+    rng = np.random.default_rng(7)
+    raw = rand_batch(rng, B)
+    hot_size = (1 << kw["hot_size_log2"]) if kw.get("hot_size_log2") else 0
+    hot_nnz = kw.get("hot_nnz", 0)
+
+    seq_cfg = base_cfg(
+        model, update_mode="sequential", microbatch=M, **kw
+    )
+    sstep, sstate = build(model, seq_cfg)
+    sbatch = make_batch(*raw, hot_size, hot_nnz)
+    sstate, smetrics = sstep.train(sstate, sstep.put_batch(sbatch))
+
+    dense_cfg = base_cfg(
+        model, update_mode="dense", batch_size=B // M, **kw
+    )
+    dstep, dstate = build(model, dense_cfg)
+    nll, cnt = 0.0, 0.0
+    for j in range(M):
+        db = make_batch(*slice_rows(raw, j, M), hot_size, hot_nnz)
+        dstate, dm = dstep.train(dstate, dstep.put_batch(db))
+        c = float(jax.device_get(dm["count"]))
+        nll += float(jax.device_get(dm["logloss"])) * c
+        cnt += c
+
+    for name in dstate["tables"]:
+        for part in dstate["tables"][name]:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(sstate["tables"][name][part])),
+                np.asarray(jax.device_get(dstate["tables"][name][part])),
+                rtol=1e-5,
+                atol=1e-7,
+                err_msg=f"{model}:{name}/{part}",
+            )
+    for key in dstate["dense"]:
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(sstate["dense"][key])),
+            np.asarray(jax.device_get(dstate["dense"][key])),
+            rtol=1e-5,
+            atol=1e-6,
+            err_msg=f"{model}:dense/{key}",
+        )
+    # dispatch-window metrics == weighted mean over the dense sequence
+    assert float(jax.device_get(smetrics["count"])) == cnt
+    np.testing.assert_allclose(
+        float(jax.device_get(smetrics["logloss"])),
+        nll / cnt,
+        rtol=1e-5,
+    )
+
+
+def test_sequential_empty_slice_is_noop():
+    """A slice of all-padding examples (weights 0 — multi-host step
+    alignment feeds these) must leave the carried tables untouched."""
+    rng = np.random.default_rng(3)
+    keys, slots, vals, mask, labels, weights = rand_batch(rng, B)
+    weights = weights.copy()
+    weights[1::M] = 0.0  # slice 1 entirely padding
+    mask[1::M] = 0.0
+
+    cfg = base_cfg("lr", update_mode="sequential", microbatch=M)
+    step, state = build("lr", cfg)
+    batch = make_batch(keys, slots, vals, mask, labels, weights)
+    state, _ = step.train(state, step.put_batch(batch))
+
+    dcfg = base_cfg("lr", update_mode="dense", batch_size=B // M)
+    dstep, dstate = build("lr", dcfg)
+    for j in [0, 2, 3]:  # skip the empty slice entirely
+        db = make_batch(
+            *slice_rows((keys, slots, vals, mask, labels, weights), j, M)
+        )
+        dstate, _ = dstep.train(dstate, dstep.put_batch(db))
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(state["tables"]["w"]["param"])),
+        np.asarray(jax.device_get(dstate["tables"]["w"]["param"])),
+        rtol=1e-5,
+        atol=1e-7,
+    )
+
+
+def test_sequential_sharded_matches_single():
+    rng = np.random.default_rng(11)
+    raw = rand_batch(rng, B)
+    out = {}
+    for ndev in (1, 8):
+        cfg = base_cfg(
+            "lr", update_mode="sequential", microbatch=M, num_devices=ndev
+        )
+        step, state = build("lr", cfg)
+        state, _ = step.train(state, step.put_batch(make_batch(*raw)))
+        out[ndev] = np.asarray(
+            jax.device_get(state["tables"]["w"]["param"])
+        )
+    np.testing.assert_allclose(out[1], out[8], rtol=1e-5, atol=1e-7)
+
+
+def test_sequential_microbatch_one_is_dense():
+    """microbatch=1 degenerates to the dense single-pass step."""
+    rng = np.random.default_rng(5)
+    raw = rand_batch(rng, B)
+    states = {}
+    for mode in ("sequential", "dense"):
+        cfg = base_cfg("lr", update_mode=mode)
+        step, state = build("lr", cfg)
+        state, _ = step.train(state, step.put_batch(make_batch(*raw)))
+        states[mode] = np.asarray(
+            jax.device_get(state["tables"]["w"]["param"])
+        )
+    np.testing.assert_allclose(
+        states["sequential"], states["dense"], rtol=1e-6, atol=1e-8
+    )

@@ -139,7 +139,18 @@ class Config:
     #   gather/update/scatter only touched rows.  O(batch nnz) work,
     #   preferable when the table vastly exceeds per-step HBM traffic
     #   budget or on CPU.
-    # Both paths produce identical results (tests/test_update_modes.py).
+    # "sequential": the dense machinery, but the optimizer applies per
+    #   microbatch SLICE inside the scan (tables ride the scan carry),
+    #   so the effective update granularity is batch_size/microbatch
+    #   while the host dispatches batch_size examples per call.  This
+    #   composes the TPU dispatch rate with small-batch FTRL
+    #   convergence (the reference's effective per-thread block is a
+    #   few hundred rows, lr_worker.cc:116-118,190-196): gradients are
+    #   divided by the SLICE's real count and each slice sees the
+    #   tables as left by the previous slice — step-for-step the same
+    #   training as batch_size/microbatch-sized dense steps.
+    # dense ≡ sparse identically; sequential ≡ a sequence of dense
+    # steps (tests/test_update_modes.py, tests/test_sequential.py).
     update_mode: str = "dense"
 
     # Gradient-accumulation slices per train step (1 = off).  The batch
@@ -149,8 +160,14 @@ class Config:
     # step as microbatch=1 (scatter-add order aside), but every
     # [batch, nnz, D]-shaped intermediate shrinks by the slice count.
     # This is the memory lever for wide-row models (FFM's pair tensors,
-    # docs/PERF.md layout section): big B on a small chip.  Requires
-    # update_mode="dense" and microbatch | batch_size.
+    # docs/PERF.md layout section): big B on a small chip.  Under
+    # update_mode="sequential" the same slicing instead sets the
+    # effective optimizer batch (batch_size/microbatch).  Requires
+    # update_mode="dense"/"sequential" and microbatch | batch_size.
+    # Slices are interleaved (example i → slice i % microbatch) so each
+    # slice stays evenly spread over the batch-sharded mesh axis — a
+    # contiguous split would cut across device shards and force a
+    # reshard per slice.
     microbatch: int = 1
 
     # -- hot table (frequency-partitioned head; docs/PERF.md "The win") --
@@ -159,7 +176,7 @@ class Config:
     # rows [0, H) (io/freq.py) and their gather/scatter runs as two-level
     # one-hot MXU matmuls (ops/hot.py) instead of per-slice DMA —
     # measured ~2x (f32) to ~4x (bf16) on the hot fraction on v5e.
-    # Requires update_mode="dense".
+    # Requires update_mode="dense" or "sequential".
     hot_size_log2: int = 0
     # Static hot-key slots per sample (extra capacity on top of max_nnz;
     # per-row hot overflow spills to the cold/DMA path, which is always
@@ -196,23 +213,27 @@ class Config:
             raise ValueError(f"unknown model {self.model!r}")
         if self.optimizer not in ("ftrl", "sgd"):
             raise ValueError(f"unknown optimizer {self.optimizer!r}")
-        if self.update_mode not in ("dense", "sparse"):
+        if self.update_mode not in ("dense", "sparse", "sequential"):
             raise ValueError(f"unknown update_mode {self.update_mode!r}")
         if not 10 <= self.table_size_log2 <= 30:
             raise ValueError("table_size_log2 must be in [10, 30]")
         if self.microbatch < 1:
             raise ValueError("microbatch must be >= 1")
         if self.microbatch > 1:
-            if self.update_mode != "dense":
-                raise ValueError("microbatch requires update_mode='dense'")
+            if self.update_mode not in ("dense", "sequential"):
+                raise ValueError(
+                    "microbatch requires update_mode='dense' or 'sequential'"
+                )
             if self.batch_size % self.microbatch:
                 raise ValueError(
                     f"microbatch {self.microbatch} must divide "
                     f"batch_size {self.batch_size}"
                 )
         if self.hot_size_log2:
-            if self.update_mode != "dense":
-                raise ValueError("hot table requires update_mode='dense'")
+            if self.update_mode not in ("dense", "sequential"):
+                raise ValueError(
+                    "hot table requires update_mode='dense' or 'sequential'"
+                )
             if not 0 < self.hot_size_log2 < self.table_size_log2:
                 raise ValueError(
                     "hot_size_log2 must be in (0, table_size_log2)"

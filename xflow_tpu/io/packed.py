@@ -207,10 +207,20 @@ def iter_batches(
 
     if mm is not None:
         end = len(mm)
+        if offset > end:
+            # A resume cursor past EOF means the cache was rebuilt
+            # shorter since the checkpoint — distinguish it from a
+            # partial trailing record, and fail the same way the CSR
+            # cache does (binary.py 'start_offset ... past the shard
+            # end') rather than silently dropping the shard remainder.
+            raise ValueError(
+                f"resume offset {offset} is past the packed shard end "
+                f"{end} — was the cache rebuilt since the checkpoint?"
+            )
         while offset + rec_size <= end:
             yield record(mm, offset), offset, offset + rec_size
             offset += rec_size
-        if offset != end:
+        if offset < end:
             raise ValueError("truncated packed shard record")
         return
     f.seek(offset)

@@ -30,7 +30,7 @@ from xflow_tpu.models.base import BatchArrays, Model
 from xflow_tpu.ops.sparse import consolidate, gather_rows, scatter_rows
 from xflow_tpu.optim.base import Optimizer
 from xflow_tpu.parallel.mesh import batch_sharding, table_sharding
-from xflow_tpu.utils.metrics import logloss, sigmoid_ref
+from xflow_tpu.utils.metrics import logloss, logloss_sum, sigmoid_ref
 
 # State pytree:
 # {"tables": {name: {"param": [T,D], <aux>: [T,D]...}},
@@ -140,6 +140,21 @@ def batch_to_compact(batch: Batch, check: bool = True) -> BatchArrays:
     if check:
         validate_compact_batch(batch)
     return {k: jnp.asarray(v) for k, v in compact_wire_np(batch).items()}
+
+
+def _interleaved_slices(batch: BatchArrays, s: int) -> BatchArrays:
+    """Split the batch dim into s scan slices with INTERLEAVED example
+    assignment (example i → slice i % s): each slice stays evenly
+    spread over the batch-sharded mesh axis, so GSPMD sees a local
+    strided view per slice instead of the reshard/all-to-all a
+    contiguous split would force (slice 0 = first B/s rows = one
+    device's shard).  Both scan modes are composition-insensitive:
+    accumulate is order-independent, and sequential's slice sequence
+    is an arbitrary partition of the dispatch window by design."""
+    return {
+        k: v.reshape((v.shape[0] // s, s) + v.shape[1:]).swapaxes(0, 1)
+        for k, v in batch.items()
+    }
 
 
 class TrainStep:
@@ -376,6 +391,9 @@ class TrainStep:
         dense = state["dense"]
         num_real = jnp.maximum(jnp.sum(batch["weights"]), 1.0)
 
+        if cfg.update_mode == "sequential" and cfg.microbatch > 1:
+            return self._train_sequential(state, batch)
+
         if cfg.update_mode == "sparse":
             pctr, occ_grads, grad_dense = self._forward_grads(
                 tables, dense, batch, num_real
@@ -427,10 +445,7 @@ class TrainStep:
             # slices so every [B-slice, nnz, D] intermediate is 1/s the
             # size.  Grads are pre-divided by the FULL batch num_real, so
             # the accumulated buffers equal the single-pass ones.
-            xs = {
-                k: v.reshape((s, v.shape[0] // s) + v.shape[1:])
-                for k, v in batch.items()
-            }
+            xs = _interleaved_slices(batch, s)
             gdense0 = jax.tree.map(jnp.zeros_like, dense)
 
             def body(carry, bslice):
@@ -446,9 +461,7 @@ class TrainStep:
                         lambda a, b: a + b, gdense_c, gd
                     )
                 w = bslice["weights"]
-                nll_c = nll_c + logloss(
-                    bslice["labels"], pctr_s, w
-                ) * jnp.sum(w)
+                nll_c = nll_c + logloss_sum(bslice["labels"], pctr_s, w)
                 return (gbufs_c, gdense_c, nll_c, cnt_c + jnp.sum(w)), None
 
             zero = jnp.zeros((), jnp.float32)
@@ -467,16 +480,82 @@ class TrainStep:
             state, new_tables, dense, grad_dense, ll, cnt
         )
 
-    def _finish_step(self, state, new_tables, dense, grad_dense, ll, cnt):
-        """Shared step tail for both update modes: dense (MLP) params
-        take plain SGD regardless of the table optimizer
-        (models/wide_deep.py rationale) — one copy of that rule, so
-        dense vs sparse mode cannot drift apart."""
-        new_dense = dense
-        if dense and grad_dense is not None:
-            new_dense = jax.tree.map(
-                lambda p, g: p - self.cfg.sgd_lr * g, dense, grad_dense
+    def _train_sequential(
+        self, state: State, batch: BatchArrays
+    ) -> tuple[State, dict[str, jax.Array]]:
+        """update_mode='sequential': scan over microbatch slices with
+        the TABLES in the scan carry — the optimizer recurrence runs
+        once per slice, with gradients divided by the SLICE's real
+        count, and slice k reads the tables as slice k-1 left them.
+        One dispatch of batch_size examples is therefore step-for-step
+        the same training as `microbatch` successive dense steps of
+        batch_size/microbatch examples (tests/test_sequential.py
+        asserts bitwise-close equality).  This is what composes the
+        proven small-batch FTRL convergence (docs/CONVERGENCE.md,
+        B=512) with device-rate dispatch: the reference's effective
+        optimizer batch is a per-thread text-block slice of a few
+        hundred rows (lr_worker.cc:116-118,190-196), which a
+        throughput-sized B would otherwise dilute ~256×.
+
+        Cost model: each slice pays one full-table elementwise
+        optimizer pass (streaming ~7 arrays of [T, D] HBM traffic), so
+        wall-clock per example grows with microbatch × table bytes /
+        batch — see docs/PERF.md 'Sequential mode' for the measured
+        ladder."""
+        cfg = self.cfg
+        tables = state["tables"]
+        dense = state["dense"]
+        s = cfg.microbatch
+        xs = _interleaved_slices(batch, s)
+
+        def body(carry, bslice):
+            tables_c, dense_c, nll_c, cnt_c = carry
+            w_sum = jnp.sum(bslice["weights"])
+            num_real = jnp.maximum(w_sum, 1.0)
+            pctr_s, occ_s, gd = self._forward_grads(
+                tables_c, dense_c, bslice, num_real
             )
+            gbufs = {
+                name: jnp.zeros_like(t["param"])
+                for name, t in tables_c.items()
+            }
+            gbufs = self._scatter_grads(tables_c, bslice, occ_s, gbufs)
+            new_tables = {
+                name: self.optimizer.update_rows(table, gbufs[name])
+                for name, table in tables_c.items()
+            }
+            new_dense = self._apply_dense_sgd(dense_c, gd)
+            nll_c = nll_c + logloss_sum(
+                bslice["labels"], pctr_s, bslice["weights"]
+            )
+            return (new_tables, new_dense, nll_c, cnt_c + w_sum), None
+
+        zero = jnp.zeros((), jnp.float32)
+        (new_tables, new_dense, nll_sum, cnt), _ = jax.lax.scan(
+            body, (tables, dense, zero, zero), xs
+        )
+        ll = nll_sum / jnp.maximum(cnt, 1.0)
+        return {
+            "tables": new_tables,
+            "dense": new_dense,
+            "step": state["step"] + 1,
+        }, {"logloss": ll, "count": cnt}
+
+    def _apply_dense_sgd(self, dense: dict, grad_dense) -> dict:
+        """Dense (MLP) params take plain SGD regardless of the table
+        optimizer (models/wide_deep.py rationale) — the ONE copy of
+        that rule, shared by _finish_step (per-dispatch application)
+        and _train_sequential (per-slice application), so the update
+        modes cannot drift apart."""
+        if not dense or grad_dense is None:
+            return dense
+        return jax.tree.map(
+            lambda p, g: p - self.cfg.sgd_lr * g, dense, grad_dense
+        )
+
+    def _finish_step(self, state, new_tables, dense, grad_dense, ll, cnt):
+        """Shared step tail for the non-sequential update modes."""
+        new_dense = self._apply_dense_sgd(dense, grad_dense)
         metrics = {"logloss": ll, "count": cnt}
         return {
             "tables": new_tables,

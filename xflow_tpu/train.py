@@ -57,7 +57,8 @@ def build_parser() -> argparse.ArgumentParser:
         "optimizer step at 1/N the batch-shaped memory",
     )
     p.add_argument(
-        "--update-mode", dest="update_mode", choices=["dense", "sparse"],
+        "--update-mode", dest="update_mode",
+        choices=["dense", "sparse", "sequential"],
         help="dense: scatter-add + full-table optimizer pass (TPU-fast); "
         "sparse: sort/consolidate + touched-rows-only update (small "
         "batches, CPU)",

@@ -40,12 +40,19 @@ def sigmoid_ref(x: jax.Array) -> jax.Array:
 
 def logloss(labels: jax.Array, pctr: jax.Array, weights: jax.Array | None = None):
     """Weighted mean negative log-likelihood (natural log)."""
+    if weights is None:
+        return logloss_sum(labels, pctr, jnp.ones_like(pctr)) / pctr.size
+    denom = jnp.maximum(jnp.sum(weights), 1.0)
+    return logloss_sum(labels, pctr, weights) / denom
+
+
+def logloss_sum(labels: jax.Array, pctr: jax.Array, weights: jax.Array):
+    """Weighted SUM of negative log-likelihood — the accumulator form
+    used by microbatch scans, where re-normalizing a clamped per-slice
+    mean would mis-scale fractional-weight slices."""
     p = jnp.clip(pctr, LOGLOSS_EPS, 1.0 - LOGLOSS_EPS)
     ll = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))
-    if weights is None:
-        return jnp.mean(ll)
-    denom = jnp.maximum(jnp.sum(weights), 1.0)
-    return jnp.sum(ll * weights) / denom
+    return jnp.sum(ll * weights)
 
 
 def auc_rank_sum(labels: np.ndarray, pctr: np.ndarray) -> float:
