@@ -563,7 +563,54 @@ def main() -> None:
     except Exception as e:
         result["e2e_error"] = f"{type(e).__name__}: {e}"
 
+    _finalize_artifact(result, force_cpu, accel_eps)
     print(json.dumps(result))
+
+
+def _finalize_artifact(result: dict, force_cpu: bool, accel_eps) -> None:
+    """Outage-proof the artifact of record (round-4 lesson: the TPU
+    tunnel died mid-round and BENCH_r04.json silently became a CPU
+    self-comparison at vs_baseline 1.0).
+
+    - An accelerator was EXPECTED (not XFLOW_BENCH_CPU=1) but the run
+      landed on CPU: mark ``degraded: true`` and null out vs_baseline —
+      a CPU-vs-CPU ratio is not the metric — and point at the newest
+      committed last-good TPU artifact so downstream readers compare
+      against a real number instead of concluding a regression.
+    - A successful accelerator run: persist the full JSON under
+      docs/artifacts/bench_tpu_*.json, so the last-good number is
+      always a citable artifact rather than prose.
+    """
+    art_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "docs", "artifacts"
+    )
+    if not force_cpu and accel_eps is None:
+        result["degraded"] = True
+        result["vs_baseline"] = None
+        try:
+            import glob as _glob
+
+            good = sorted(
+                _glob.glob(os.path.join(art_dir, "bench_tpu_*.json"))
+            )
+            if good:
+                result["last_good_artifact"] = os.path.join(
+                    "docs", "artifacts", os.path.basename(good[-1])
+                )
+        except OSError:
+            pass
+    elif accel_eps is not None:
+        try:
+            os.makedirs(art_dir, exist_ok=True)
+            name = "bench_tpu_{}.json".format(
+                time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            )
+            with open(os.path.join(art_dir, name), "w") as f:
+                json.dump(result, f, indent=2, sort_keys=True)
+                f.write("\n")
+            result["artifact"] = os.path.join("docs", "artifacts", name)
+        except OSError as e:
+            result["artifact_error"] = f"{type(e).__name__}: {e}"
 
 
 if __name__ == "__main__":
@@ -576,8 +623,9 @@ if __name__ == "__main__":
                     "metric": "lr_ftrl_train_examples_per_sec",
                     "value": 0.0,
                     "unit": "examples/sec",
-                    "vs_baseline": 0.0,
+                    "vs_baseline": None,
                     "backend": "unknown",
+                    "degraded": True,
                     "error": f"{type(e).__name__}: {e}",
                 }
             )
