@@ -16,7 +16,9 @@ def _tables(t):
     )
 
 
-@pytest.mark.parametrize("model", ["lr", "fm"])
+@pytest.mark.parametrize(
+    "model", ["lr", "fm", "mvm", "ffm", "wide_deep"]
+)
 @pytest.mark.parametrize("hot", [False, True])
 def test_compact_equals_full(toy_dataset, model, hot, tmp_path):
     base = dict(
@@ -27,7 +29,11 @@ def test_compact_equals_full(toy_dataset, model, hot, tmp_path):
         batch_size=64,
         table_size_log2=14,
         max_nnz=24,
+        max_fields=12,
         num_devices=1,
+        emb_dim=4,
+        hidden_dim=8,
+        ffm_v_dim=2,
     )
     if hot:
         base.update(
@@ -56,12 +62,15 @@ def test_compact_equals_full(toy_dataset, model, hot, tmp_path):
     np.testing.assert_allclose(r_full["auc"], r_cmp["auc"], rtol=1e-5)
 
 
-def test_compact_rejected_for_slot_models(toy_dataset):
-    with pytest.raises(ValueError, match="compact"):
+def test_compact_rejected_when_slots_exceed_u8(toy_dataset):
+    """Slot-reading models need max_fields <= 255 for the u8 slots
+    plane's clamp to stay inside the ignored range."""
+    with pytest.raises(ValueError, match="max_fields"):
         Trainer(
             Config(
                 model="mvm",
                 wire_mode="compact",
+                max_fields=300,
                 train_path=toy_dataset.train_prefix,
                 batch_size=64,
                 table_size_log2=14,
@@ -78,11 +87,58 @@ def test_auto_picks_compact_only_when_valid(toy_dataset):
         num_devices=1,
     )
     assert Trainer(Config(model="lr", **common)).step.compact_wire
-    assert not Trainer(Config(model="mvm", **common)).step.compact_wire
+    # slot-reading models ride compact too (u8 slots plane) ...
+    assert Trainer(Config(model="mvm", **common)).step.compact_wire
+    # ... unless their field space outgrows u8
+    assert not Trainer(
+        Config(model="mvm", max_fields=256, **common)
+    ).step.compact_wire
     # numeric mode carries real values -> full wire even for lr
     assert not Trainer(
         Config(model="lr", hash_mode=False, **common)
     ).step.compact_wire
+
+
+def test_u8_slot_clamp_matches_full_wire():
+    """A slot beyond 255 clamps to 255 on the compact wire — still >=
+    max_fields, so the model ignores it exactly as the full wire does
+    (the lossless-clamp invariant compact_wire_np relies on)."""
+    from xflow_tpu.io.batch import make_batch
+    from xflow_tpu.models import make_model
+    from xflow_tpu.optim import make_optimizer
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.parallel.step import TrainStep, init_state
+
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 10, (8, 6)).astype(np.int32)
+    slots = rng.integers(0, 8, (8, 6)).astype(np.int32)
+    slots[0, 0] = 300  # out of u8 range AND >= max_fields
+    slots[1, 1] = 200  # in u8 range but >= max_fields
+    slots[2, 2] = -256  # negative: a plain u8 cast would wrap to 0
+    slots[3, 3] = -250  # negative: would wrap to 6 (a live field)
+    vals = np.ones((8, 6), np.float32)
+    mask = np.ones((8, 6), np.float32)
+    labels = (rng.uniform(size=8) < 0.5).astype(np.float32)
+    weights = np.ones(8, np.float32)
+    batch = make_batch(keys, slots, vals, mask, labels, weights)
+
+    out = {}
+    for wire in ("full", "compact"):
+        cfg = Config(
+            model="mvm", batch_size=8, table_size_log2=10, max_nnz=6,
+            max_fields=8, num_devices=1, wire_mode=wire,
+        )
+        mesh = make_mesh(1)
+        model, opt = make_model(cfg), make_optimizer(cfg)
+        step = TrainStep(model, opt, cfg, mesh)
+        state = init_state(model, opt, cfg, mesh)
+        state, _ = step.train(state, step.put_batch(batch))
+        out[wire] = np.asarray(
+            jax.device_get(state["tables"]["v"]["param"])
+        )
+    np.testing.assert_allclose(
+        out["full"], out["compact"], rtol=1e-5, atol=1e-7
+    )
 
 
 def test_compact_guards_value_batches():

@@ -199,12 +199,14 @@ class Config:
     # -- host->device wire format --
     # "full": ship keys/slots/vals/mask/labels/weights as-is.
     # "compact": ship sentinel-coded int32 keys (-1 = padding) + uint8
-    #   labels/weights only (~4x fewer bytes) and reconstruct
-    #   vals/mask/slots inside the jitted step.  Valid only in hash mode
-    #   (vals are identically 1, load_data_from_disk.cc:151) with a
-    #   model that never reads slots (lr, fm).  On links where
-    #   host->device bandwidth bounds e2e throughput (measured ~150-250
-    #   MB/s here, docs/PERF.md) this is a ~4x e2e lever.
+    #   labels/weights (~4x fewer bytes; slot-reading models — mvm,
+    #   ffm, wide_deep — add a uint8 slots plane, ~3x) and reconstruct
+    #   vals/mask (and slots where none shipped) inside the jitted
+    #   step.  Valid only in hash mode (vals are identically 1,
+    #   load_data_from_disk.cc:151); slot-reading models additionally
+    #   need max_fields <= 255.  On links where host->device bandwidth
+    #   bounds e2e throughput (measured ~150-250 MB/s here,
+    #   docs/PERF.md) this is the main e2e lever.
     # "auto" (default): compact whenever valid, else full.
     wire_mode: str = "auto"  # {"auto", "full", "compact"}
 

@@ -103,5 +103,12 @@ class MVMModel:
             0.0,  # guard mirrors the reference zeroing at mvm_worker.cc:156
             prod[:, None, :] / safe,
         ) * x[..., None]
-        valid = (batch["slots"] < self.max_fields)[..., None]
+        # match the forward's one-hot semantics exactly: slots outside
+        # [0, max_fields) contribute nothing there (zero one-hot row),
+        # so they must get zero gradient here too — without the >= 0
+        # arm, a negative slot was ignored in the forward but trained
+        # as field 0 (the clip above) in the backward
+        valid = (
+            (batch["slots"] >= 0) & (batch["slots"] < self.max_fields)
+        )[..., None]
         return {"v": jnp.where(valid, grad_v, 0.0)}

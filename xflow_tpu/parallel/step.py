@@ -111,35 +111,62 @@ def validate_compact_batch(batch: Batch) -> None:
             )
 
 
-def compact_wire_np(batch: Batch) -> dict:
+def compact_wire_np(batch: Batch, ship_slots: bool = False) -> dict:
     """The numpy (host) half of the compact wire: sentinel-coded int32
-    keys + uint8 labels/weights.  Shared by batch_to_compact and the
-    bench's host-feed measurement so the measured per-batch work is by
-    construction exactly the work the training feed performs."""
+    keys + uint8 labels/weights, plus a uint8 slots plane for models
+    that read field ids.  Shared by batch_to_compact and the bench's
+    host-feed measurement so the measured per-batch work is by
+    construction exactly the work the training feed performs.
+
+    The u8 slot clamp (min(slot, 255)) is lossless under the models'
+    shared out-of-range semantics: every slot consumer drops fields >=
+    max_fields via a one-hot row of zeros (mvm.py:76, ffm.py:11,
+    wide_deep.py:73), so with max_fields <= 255 (enforced at TrainStep
+    init) a clamped slot lands in the ignored range either way."""
     import numpy as np
 
     def sentinel(keys, mask):
         return np.where(mask > 0, keys, np.int32(-1)).astype(np.int32)
+
+    def slots_u8(slots):
+        # anything outside [0, 255] maps to 255 (>= max_fields → the
+        # models ignore it, like the full wire does for negative or
+        # oversized slots) — a plain uint8 cast would WRAP negatives
+        # into the live field range
+        return np.where(
+            (slots < 0) | (slots > 255), 255, slots
+        ).astype(np.uint8)
 
     out = {
         "ckeys": sentinel(batch.keys, batch.mask),
         "labels_u8": batch.labels.astype(np.uint8),
         "weights_u8": batch.weights.astype(np.uint8),
     }
+    if ship_slots:
+        out["slots_u8"] = slots_u8(batch.slots)
     if batch.hot_nnz:
         out["hot_ckeys"] = sentinel(batch.hot_keys, batch.hot_mask)
+        if ship_slots:
+            out["hot_slots_u8"] = slots_u8(batch.hot_slots)
     return out
 
 
-def batch_to_compact(batch: Batch, check: bool = True) -> BatchArrays:
+def batch_to_compact(
+    batch: Batch, check: bool = True, ship_slots: bool = False
+) -> BatchArrays:
     """Compact wire (Config.wire_mode): sentinel-coded keys + uint8
-    labels/weights — ~16x fewer bytes/entry than the full format.
-    Only valid when vals are identically 1 for real entries (hash mode)
-    and the model never reads slots; _expand_wire reconstructs
-    vals/mask/slots on device."""
+    labels/weights — ~16x fewer bytes/entry than the full format for
+    slot-free models (lr, fm); slot-reading models (mvm, ffm,
+    wide_deep) add a uint8 slots plane (~3x).  Only valid when vals
+    are identically 1 for real entries (hash mode); _expand_wire
+    reconstructs vals/mask (and zero slots when none shipped) on
+    device."""
     if check:
         validate_compact_batch(batch)
-    return {k: jnp.asarray(v) for k, v in compact_wire_np(batch).items()}
+    return {
+        k: jnp.asarray(v)
+        for k, v in compact_wire_np(batch, ship_slots).items()
+    }
 
 
 def _interleaved_slices(batch: BatchArrays, s: int) -> BatchArrays:
@@ -171,13 +198,19 @@ class TrainStep:
             jnp.bfloat16 if cfg.hot_dtype == "bfloat16" else jnp.float32
         )
         # Compact wire eligibility (Config.wire_mode): requires binary
-        # vals (hash mode) and a slot-free model.
-        compact_ok = cfg.hash_mode and not getattr(model, "uses_slots", True)
+        # vals (hash mode).  Slot-reading models additionally need
+        # max_fields <= 255 so the u8 slots plane's clamp stays inside
+        # the models' ignored range (compact_wire_np docstring).
+        self._ship_slots = bool(getattr(model, "uses_slots", True))
+        compact_ok = cfg.hash_mode and not (
+            self._ship_slots and cfg.max_fields > 255
+        )
         if cfg.wire_mode == "compact" and not compact_ok:
             raise ValueError(
-                "wire_mode='compact' requires hash_mode and a model that "
-                f"ignores slots; model {model.name!r} / hash_mode="
-                f"{cfg.hash_mode} does not qualify"
+                "wire_mode='compact' requires hash_mode (binary vals) "
+                "and, for slot-reading models, max_fields <= 255; model "
+                f"{model.name!r} / hash_mode={cfg.hash_mode} / "
+                f"max_fields={cfg.max_fields} does not qualify"
             )
         self.compact_wire = cfg.wire_mode != "full" and compact_ok
         self._compact_validated = False
@@ -189,7 +222,9 @@ class TrainStep:
     def put_batch(self, batch: Batch) -> BatchArrays:
         if self.compact_wire:
             arrays = batch_to_compact(
-                batch, check=not self._compact_validated
+                batch,
+                check=not self._compact_validated,
+                ship_slots=self._ship_slots,
             )
             self._compact_validated = True
         else:
@@ -212,14 +247,19 @@ class TrainStep:
     def _expand_wire(self, batch: BatchArrays) -> BatchArrays:
         """Inverse of batch_to_compact, inside the jitted step: padding
         is key == -1; real entries have val = mask = 1 (hash mode);
-        slots are never read by compact-eligible models (zeros)."""
+        slots widen from the u8 plane when the model reads them, else
+        reconstruct as zeros."""
         if "ckeys" not in batch:
             return batch
         ckeys = batch["ckeys"]
         mask = (ckeys >= 0).astype(jnp.float32)
         out = {
             "keys": jnp.maximum(ckeys, 0),
-            "slots": jnp.zeros_like(ckeys),
+            "slots": (
+                batch["slots_u8"].astype(jnp.int32)
+                if "slots_u8" in batch
+                else jnp.zeros_like(ckeys)
+            ),
             "vals": mask,
             "mask": mask,
             "labels": batch["labels_u8"].astype(jnp.float32),
@@ -229,7 +269,11 @@ class TrainStep:
             hot = batch["hot_ckeys"]
             hmask = (hot >= 0).astype(jnp.float32)
             out["hot_keys"] = jnp.maximum(hot, 0)
-            out["hot_slots"] = jnp.zeros_like(hot)
+            out["hot_slots"] = (
+                batch["hot_slots_u8"].astype(jnp.int32)
+                if "hot_slots_u8" in batch
+                else jnp.zeros_like(hot)
+            )
             out["hot_vals"] = hmask
             out["hot_mask"] = hmask
         return out
@@ -387,12 +431,12 @@ class TrainStep:
     ) -> tuple[State, dict[str, jax.Array]]:
         cfg = self.cfg
         batch = self._expand_wire(batch)
+        if cfg.update_mode == "sequential" and cfg.microbatch > 1:
+            return self._train_sequential(state, batch)
+
         tables = state["tables"]
         dense = state["dense"]
         num_real = jnp.maximum(jnp.sum(batch["weights"]), 1.0)
-
-        if cfg.update_mode == "sequential" and cfg.microbatch > 1:
-            return self._train_sequential(state, batch)
 
         if cfg.update_mode == "sparse":
             pctr, occ_grads, grad_dense = self._forward_grads(

@@ -61,7 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["dense", "sparse", "sequential"],
         help="dense: scatter-add + full-table optimizer pass (TPU-fast); "
         "sparse: sort/consolidate + touched-rows-only update (small "
-        "batches, CPU)",
+        "batches, CPU); sequential: optimizer applies per --microbatch "
+        "slice inside the dispatched step, so the effective optimizer "
+        "batch is batch-size/microbatch (small-batch convergence at "
+        "device dispatch rates)",
     )
     p.add_argument("--alpha", type=float)
     p.add_argument("--beta", type=float)
