@@ -87,6 +87,21 @@ def run_one(name: str, args) -> None:
     iters = args.iters if accel else max(2, args.iters // 3)
 
     cfg = dict(model_cfgs(1 << args.batch_log2, accel))[name]
+    # geometry overrides for hot-head scaling sweeps (VERDICT r4 #4:
+    # find each D>1 model's mass-vs-h2*D-traffic optimum)
+    over = {}
+    if args.hot_log2 is not None:
+        over["hot_size_log2"] = args.hot_log2
+    if args.hot_nnz is not None:
+        over["hot_nnz"] = args.hot_nnz
+    if args.cold_nnz is not None:
+        over["max_nnz"] = args.cold_nnz
+    if args.hot_dtype is not None:
+        over["hot_dtype"] = args.hot_dtype
+    if args.microbatch is not None:
+        over["microbatch"] = args.microbatch
+    if over:
+        cfg = cfg.replace(**over)
     csr = remap = None
     if not args.synthetic:
         try:
@@ -152,11 +167,30 @@ def main() -> None:
         help="bench ONE model inline (child mode); default: all models, "
         "each in its own subprocess",
     )
+    ap.add_argument("--hot-log2", type=int, default=None,
+                    help="override hot_size_log2 (0 = hot off)")
+    ap.add_argument("--hot-nnz", type=int, default=None)
+    ap.add_argument("--cold-nnz", type=int, default=None,
+                    help="override max_nnz (cold capacity)")
+    ap.add_argument("--hot-dtype", default=None,
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--microbatch", type=int, default=None)
     args = ap.parse_args()
 
     if args.model is not None:
         run_one(args.model, args)
         return
+
+    if any(
+        v is not None
+        for v in (args.hot_log2, args.hot_nnz, args.cold_nnz,
+                  args.hot_dtype, args.microbatch)
+    ):
+        # geometry overrides are per-model sweep knobs; applied fleet-
+        # wide they'd also rewrite the *_nohot control rows (making the
+        # hot-vs-nohot comparison hot-vs-hot) and hand FFM a hot table
+        # its 156-wide rows can't ride (model_cfgs docstring)
+        ap.error("geometry overrides require --model (child mode)")
 
     # Parent mode: one subprocess per model.  Isolation matters — a
     # model whose tables cannot fit (or that trips an OOM) must not
