@@ -170,6 +170,18 @@ class Config:
     # reshard per slice.
     microbatch: int = 1
 
+    # Consolidate duplicate cold-section keys (one shared argsort +
+    # per-table segment-sums) before the dense-mode scatter-add.  Zipf
+    # batches duplicate heavily even after hot steering (measured 53%
+    # duplicate cold occurrences at the FM flagship geometry, 90%
+    # hot-off — docs/PERF.md "Cold consolidation"), and multi-lane
+    # (D>1) scatter-add costs ~85-107 ns/slice, so collapsing
+    # duplicates removes most of those slices.  Worth it for D>1
+    # models (fm/mvm/wide_deep/ffm) at large batch; LR's scalar
+    # scatters are too cheap for the sort to pay.  dense/sequential
+    # modes only (sparse mode already consolidates).
+    cold_consolidate: bool = False
+
     # -- hot table (frequency-partitioned head; docs/PERF.md "The win") --
     # log2 of the hot-table row count H (0 = off).  CTR key distributions
     # are zipfian; the top-H keys by frequency are permuted into table
@@ -231,6 +243,14 @@ class Config:
                     f"microbatch {self.microbatch} must divide "
                     f"batch_size {self.batch_size}"
                 )
+        if self.cold_consolidate and self.update_mode not in (
+            "dense",
+            "sequential",
+        ):
+            raise ValueError(
+                "cold_consolidate requires update_mode='dense' or "
+                "'sequential' (sparse mode already consolidates)"
+            )
         if self.hot_size_log2:
             if self.update_mode not in ("dense", "sequential"):
                 raise ValueError(

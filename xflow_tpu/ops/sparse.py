@@ -53,22 +53,50 @@ def consolidate(
       sorted order with its summed gradient; unused slots hold the
       sentinel key and g=0.
     """
+    order, seg, ukeys = consolidate_plan(keys, table_size)
+    # Sentinel inputs (padding) form the last segment(s); their ukey is the
+    # sentinel itself, so they stay inert.
+    return ukeys, consolidate_apply(grads, order, seg)
+
+
+def consolidate_plan(
+    keys: jax.Array, table_size: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The key-only half of ``consolidate``, computed ONCE per batch and
+    shared across a model's tables (they index with the same keys):
+    returns (order [M], seg [M], ukeys [M]).  Apply per table with
+    ``consolidate_apply``.
+
+    Motivation (docs/PERF.md "Cold consolidation"): zipf batches carry
+    heavy duplication even after hot steering — measured 53% duplicate
+    cold occurrences at the FM flagship geometry, 90% hot-off — and
+    multi-lane (D>1) scatter-add costs ~85-107 ns/slice, so collapsing
+    duplicates ahead of the scatter removes over half its slices at the
+    price of one shared argsort."""
     m = keys.shape[0]
     order = jnp.argsort(keys)
     sk = jnp.take(keys, order)
-    sg = jnp.take(grads, order, axis=0)
     is_start = jnp.concatenate(
         [jnp.ones((1,), dtype=bool), sk[1:] != sk[:-1]]
     )
-    seg = jnp.cumsum(is_start) - 1  # [M] segment id per sorted entry
-    gsum = jax.ops.segment_sum(sg, seg, num_segments=m)
+    seg = jnp.cumsum(is_start) - 1
     sentinel = jnp.int32(table_size)
     ukeys = jnp.full((m,), sentinel, dtype=jnp.int32).at[seg].set(
         sk, mode="drop"
     )
-    # Sentinel inputs (padding) form the last segment(s); their ukey is the
-    # sentinel itself, so they stay inert.
-    return ukeys, gsum
+    return order, seg, ukeys
+
+
+def consolidate_apply(
+    grads: jax.Array, order: jax.Array, seg: jax.Array
+) -> jax.Array:
+    """Per-table half of the shared consolidation: permute [M, D]
+    gradients into key-sorted order and segment-sum; slot i of the
+    result pairs with ``ukeys[i]`` from the plan (sentinel slots get
+    g=0 because padding gradients are 0 and duplicates collapse into
+    their segment head)."""
+    sg = jnp.take(grads, order, axis=0)
+    return jax.ops.segment_sum(sg, seg, num_segments=order.shape[0])
 
 
 def gather_rows(table: jax.Array, ukeys: jax.Array) -> jax.Array:

@@ -27,7 +27,13 @@ import jax.numpy as jnp
 from xflow_tpu.config import Config
 from xflow_tpu.io.batch import Batch
 from xflow_tpu.models.base import BatchArrays, Model
-from xflow_tpu.ops.sparse import consolidate, gather_rows, scatter_rows
+from xflow_tpu.ops.sparse import (
+    consolidate,
+    consolidate_apply,
+    consolidate_plan,
+    gather_rows,
+    scatter_rows,
+)
 from xflow_tpu.optim.base import Optimizer
 from xflow_tpu.parallel.mesh import batch_sharding, table_sharding
 from xflow_tpu.utils.metrics import logloss, logloss_sum, sigmoid_ref
@@ -397,6 +403,11 @@ class TrainStep:
         keys_eff = jnp.where(
             batch["mask"] > 0, batch["keys"], sentinel
         ).reshape(-1)
+        plan = None
+        if cfg.cold_consolidate:
+            # one shared argsort over the cold keys; every table's
+            # gradients ride the same permutation/segments
+            plan = consolidate_plan(keys_eff, cfg.table_size)
         if kh:
             from xflow_tpu.ops.hot import hot_scatter
 
@@ -414,9 +425,14 @@ class TrainStep:
                 # buffer; cold grads keep the DMA scatter path.
                 hot_g = occ[:, :kh].reshape(-1, d)
                 occ = occ[:, kh:]
-            gbuf = gbufs[name].at[keys_eff].add(
-                occ.reshape(-1, d), mode="drop"
-            )
+            if plan is not None:
+                order, seg, ukeys = plan
+                gsum = consolidate_apply(occ.reshape(-1, d), order, seg)
+                gbuf = gbufs[name].at[ukeys].add(gsum, mode="drop")
+            else:
+                gbuf = gbufs[name].at[keys_eff].add(
+                    occ.reshape(-1, d), mode="drop"
+                )
             if kh:
                 ghot = hot_scatter(
                     hot_keys_eff, hot_g, cfg.hot_size,

@@ -87,6 +87,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--hot-dtype", choices=["float32", "bfloat16"], dest="hot_dtype"
     )
     p.add_argument(
+        "--cold-consolidate", action="store_true", default=None,
+        dest="cold_consolidate",
+        help="merge duplicate cold keys (shared argsort + segment-sum) "
+        "before the dense-mode scatter-add — pays off for D>1 models "
+        "on zipf batches (docs/PERF.md)",
+    )
+    p.add_argument(
         "--wire-mode", choices=["auto", "full", "compact"], dest="wire_mode",
         help="host->device batch format; compact ships ~4x fewer bytes "
         "(hash-mode lr/fm only)",
