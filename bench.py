@@ -375,12 +375,36 @@ def bench_e2e(devices, cfg, data_path: str, result: dict, remap=None) -> None:
     from concurrent.futures import ThreadPoolExecutor
 
     best = 0.0
+    best_link = 0.0
+    wire_bytes_per_batch = None
     with ThreadPoolExecutor(1) as ex:
         for _ in range(2):
             t0 = time.perf_counter()
             n = 0
+            sent = 0
             pending = []
             for batch, _ in pk_loader.prefetch(depth=2):
+                sent += 1
+                if wire_bytes_per_batch is None:
+                    # what actually crosses the link per dispatch (the
+                    # bytes x link-MB/s reconciliation, VERDICT r4 #6)
+                    if step.compact_wire:
+                        arrays = compact_wire_np(
+                            batch, ship_slots=step._ship_slots
+                        )
+                        wire_bytes_per_batch = sum(
+                            v.nbytes for v in arrays.values()
+                        )
+                    else:
+                        wire_bytes_per_batch = sum(
+                            a.nbytes
+                            for a in (
+                                batch.keys, batch.slots, batch.vals,
+                                batch.mask, batch.labels, batch.weights,
+                                batch.hot_keys, batch.hot_slots,
+                                batch.hot_vals, batch.hot_mask,
+                            )
+                        )
                 pending.append((ex.submit(step.put_batch, batch), batch.num_real()))
                 if len(pending) > 2:
                     fut, cnt = pending.pop(0)
@@ -390,9 +414,28 @@ def bench_e2e(devices, cfg, data_path: str, result: dict, remap=None) -> None:
                 state, _ = step.train(state, fut.result())
                 n += cnt
             jax.device_get(state["tables"]["w"]["param"][:1, 0])
-            eps = n / (time.perf_counter() - t0)
-            best = max(best, eps)
+            dt = time.perf_counter() - t0
+            eps = n / dt
+            if eps > best:
+                best = eps
+                # actual bytes shipped per second this pass (every
+                # dispatched batch ships the full padded wire, so count
+                # batches, not real examples — a real-example scaling
+                # would read low by the tail-batch pad fraction)
+                if wire_bytes_per_batch:
+                    best_link = sent * wire_bytes_per_batch / dt
     result["e2e_packed_examples_per_sec"] = round(best, 1)
+    if wire_bytes_per_batch:
+        result["wire_bytes_per_batch"] = wire_bytes_per_batch
+        result["wire_bytes_per_example"] = round(
+            wire_bytes_per_batch / cfg.batch_size, 1
+        )
+        # implied link rate IF the link were the only cost.  Compare
+        # against the measured 150-250 MB/s tunnel to check the
+        # "bounded by the link, not the code" claim.
+        result["e2e_implied_link_mb_per_sec"] = round(
+            best_link / 2**20, 1
+        )
 
 
 def ensure_synth_data(path: str, num_examples: int, seed: int = 7) -> str:
