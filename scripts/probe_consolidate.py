@@ -82,6 +82,11 @@ def main():
             cons = jax.jit(cons_fn)
             sort_only = jax.jit(lambda k: jnp.argsort(k))
 
+            # does a dropped (sentinel) slice cost like a live one?  If
+            # drops are ~free, consolidation saves the full duplicate
+            # fraction of scatter time; if not, only the segment-sum's
+            # bandwidth matters.
+            all_sentinel = jnp.full_like(keys, t)
             row = {
                 "m_log2": m_log2,
                 "d": d,
@@ -91,6 +96,9 @@ def main():
                     timeit(cons, gbuf, keys, grads) * 1e3, 3
                 ),
                 "argsort_ms": round(timeit(sort_only, keys) * 1e3, 3),
+                "all_dropped_ms": round(
+                    timeit(plain, gbuf, all_sentinel, grads) * 1e3, 3
+                ),
                 "backend": jax.devices()[0].platform,
             }
             row["plain_ns_per_slice"] = round(

@@ -110,6 +110,11 @@ def main():
     p.add_argument("--max-nnz", type=int, default=40)
     p.add_argument("--hot-size-log2", type=int, default=0)
     p.add_argument("--hot-nnz", type=int, default=32)
+    p.add_argument(
+        "--sequential-inner", default="dense",
+        choices=["dense", "sparse"],
+        help="sparse = touched-rows-only per slice (T=2^28 scale)",
+    )
     p.add_argument("--examples", type=int, default=0,
                    help="cap train examples (0 = all; smoke tests)")
     p.add_argument("--test-examples", type=int, default=0)
@@ -143,6 +148,7 @@ def main():
         max_fields=39,
         num_devices=1,
         update_mode="sequential",
+        sequential_inner=args.sequential_inner,
         microbatch=args.batch_size // args.eff_batch,
         hot_size_log2=args.hot_size_log2,
         hot_nnz=args.hot_nnz,

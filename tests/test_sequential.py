@@ -168,6 +168,42 @@ def test_sequential_sharded_matches_single():
     np.testing.assert_allclose(out[1], out[8], rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.parametrize("model", ["lr", "fm", "wide_deep"])
+def test_sequential_sparse_inner_equals_dense_inner(model):
+    """config.sequential_inner='sparse' (touched-rows-only per slice —
+    the north-star-table form) is the same training as the dense
+    inner."""
+    rng = np.random.default_rng(13)
+    raw = rand_batch(rng, B)
+    out = {}
+    for inner in ("dense", "sparse"):
+        cfg = base_cfg(
+            model,
+            update_mode="sequential",
+            microbatch=M,
+            sequential_inner=inner,
+        )
+        step, state = build(model, cfg)
+        state, _ = step.train(state, step.put_batch(make_batch(*raw)))
+        out[inner] = jax.device_get(state)
+    for name in out["dense"]["tables"]:
+        for part in out["dense"]["tables"][name]:
+            np.testing.assert_allclose(
+                np.asarray(out["sparse"]["tables"][name][part]),
+                np.asarray(out["dense"]["tables"][name][part]),
+                rtol=1e-5,
+                atol=1e-7,
+                err_msg=f"{model}:{name}/{part}",
+            )
+    for key in out["dense"]["dense"]:
+        np.testing.assert_allclose(
+            np.asarray(out["sparse"]["dense"][key]),
+            np.asarray(out["dense"]["dense"][key]),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+
 def test_sequential_microbatch_one_is_dense():
     """microbatch=1 degenerates to the dense single-pass step."""
     rng = np.random.default_rng(5)

@@ -153,6 +153,17 @@ class Config:
     # steps (tests/test_update_modes.py, tests/test_sequential.py).
     update_mode: str = "dense"
 
+    # Per-slice update strategy under update_mode="sequential":
+    # "dense" — full-table elementwise optimizer pass per slice
+    #   (~7 [T,D]-arrays of HBM traffic; fine at T<=2^24).
+    # "sparse" — consolidate the slice's keys and gather/update/scatter
+    #   only touched rows; O(slice nnz) per slice, the ONLY viable form
+    #   at north-star table sizes (a 2^28 FTRL triple is ~3 GiB —
+    #   a full pass per 512-example slice would stream ~7 GiB).
+    #   Requires hot table off (the hot path accumulates into a dense
+    #   buffer).  Equivalence: tests/test_sequential.py.
+    sequential_inner: str = "dense"  # {"dense", "sparse"}
+
     # Gradient-accumulation slices per train step (1 = off).  The batch
     # is split into `microbatch` equal slices scanned sequentially;
     # per-slice gradients accumulate into the dense per-table buffers
@@ -243,6 +254,19 @@ class Config:
                     f"microbatch {self.microbatch} must divide "
                     f"batch_size {self.batch_size}"
                 )
+        if self.sequential_inner not in ("dense", "sparse"):
+            raise ValueError(
+                f"unknown sequential_inner {self.sequential_inner!r}"
+            )
+        if (
+            self.sequential_inner == "sparse"
+            and self.update_mode == "sequential"
+            and self.hot_size_log2
+        ):
+            raise ValueError(
+                "sequential_inner='sparse' requires the hot table off "
+                "(the hot path accumulates into a dense buffer)"
+            )
         if self.cold_consolidate and self.update_mode not in (
             "dense",
             "sequential",

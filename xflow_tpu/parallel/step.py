@@ -28,7 +28,6 @@ from xflow_tpu.config import Config
 from xflow_tpu.io.batch import Batch
 from xflow_tpu.models.base import BatchArrays, Model
 from xflow_tpu.ops.sparse import (
-    consolidate,
     consolidate_apply,
     consolidate_plan,
     gather_rows,
@@ -460,24 +459,7 @@ class TrainStep:
             )
             kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
             assert not kh, "hot table requires dense mode (config checks)"
-            sentinel = jnp.int32(cfg.table_size)
-            keys_eff = jnp.where(
-                batch["mask"] > 0, batch["keys"], sentinel
-            ).reshape(-1)
-            new_tables = {}
-            for name, table in tables.items():
-                d = table["param"].shape[-1]
-                ukeys, gsum = consolidate(
-                    keys_eff, occ_grads[name].reshape(-1, d), cfg.table_size
-                )
-                state_rows = {
-                    k: gather_rows(arr, ukeys) for k, arr in table.items()
-                }
-                new_rows = self.optimizer.update_rows(state_rows, gsum)
-                new_tables[name] = {
-                    k: scatter_rows(table[k], ukeys, new_rows[k])
-                    for k in table.keys()
-                }
+            new_tables = self._sparse_update(tables, batch, occ_grads)
             ll = logloss(batch["labels"], pctr, batch["weights"])
             cnt = jnp.sum(batch["weights"])
             return self._finish_step(
@@ -540,6 +522,39 @@ class TrainStep:
             state, new_tables, dense, grad_dense, ll, cnt
         )
 
+    def _sparse_update(
+        self, tables: dict, batch: BatchArrays, occ_grads: dict
+    ) -> dict:
+        """Touched-rows-only optimizer application (the reference's
+        Push path, ftrl.h:54-79): consolidate per unique key, gather
+        state rows, run the recurrence, scatter back.  Shared by the
+        sparse update mode (whole batch) and sequential mode's sparse
+        inner (per slice — the only viable per-slice form at
+        north-star table sizes)."""
+        cfg = self.cfg
+        sentinel = jnp.int32(cfg.table_size)
+        keys_eff = jnp.where(
+            batch["mask"] > 0, batch["keys"], sentinel
+        ).reshape(-1)
+        # one shared argsort; every table's gradients ride the same
+        # permutation/segments (same sharing as _scatter_grads)
+        order, seg, ukeys = consolidate_plan(keys_eff, cfg.table_size)
+        new_tables = {}
+        for name, table in tables.items():
+            d = table["param"].shape[-1]
+            gsum = consolidate_apply(
+                occ_grads[name].reshape(-1, d), order, seg
+            )
+            state_rows = {
+                k: gather_rows(arr, ukeys) for k, arr in table.items()
+            }
+            new_rows = self.optimizer.update_rows(state_rows, gsum)
+            new_tables[name] = {
+                k: scatter_rows(table[k], ukeys, new_rows[k])
+                for k in table.keys()
+            }
+        return new_tables
+
     def _train_sequential(
         self, state: State, batch: BatchArrays
     ) -> tuple[State, dict[str, jax.Array]]:
@@ -557,11 +572,14 @@ class TrainStep:
         hundred rows (lr_worker.cc:116-118,190-196), which a
         throughput-sized B would otherwise dilute ~256×.
 
-        Cost model: each slice pays one full-table elementwise
-        optimizer pass (streaming ~7 arrays of [T, D] HBM traffic), so
-        wall-clock per example grows with microbatch × table bytes /
-        batch — see docs/PERF.md 'Sequential mode' for the measured
-        ladder."""
+        Cost model (dense inner, the default): each slice pays one
+        full-table elementwise optimizer pass (streaming ~7 arrays of
+        [T, D] HBM traffic), so wall-clock per example grows with
+        microbatch × table bytes / batch.  With
+        config.sequential_inner='sparse' the slice instead pays an
+        O(slice nnz) consolidate + gather/update/scatter of touched
+        rows only — table-size-independent, the form 2^28-scale tables
+        require.  See docs/PERF.md 'Sequential mode'."""
         cfg = self.cfg
         tables = state["tables"]
         dense = state["dense"]
@@ -575,15 +593,22 @@ class TrainStep:
             pctr_s, occ_s, gd = self._forward_grads(
                 tables_c, dense_c, bslice, num_real
             )
-            gbufs = {
-                name: jnp.zeros_like(t["param"])
-                for name, t in tables_c.items()
-            }
-            gbufs = self._scatter_grads(tables_c, bslice, occ_s, gbufs)
-            new_tables = {
-                name: self.optimizer.update_rows(table, gbufs[name])
-                for name, table in tables_c.items()
-            }
+            if cfg.sequential_inner == "sparse":
+                # touched-rows-only per slice: O(slice nnz), the only
+                # viable inner at T=2^28 (config.sequential_inner)
+                new_tables = self._sparse_update(tables_c, bslice, occ_s)
+            else:
+                gbufs = {
+                    name: jnp.zeros_like(t["param"])
+                    for name, t in tables_c.items()
+                }
+                gbufs = self._scatter_grads(
+                    tables_c, bslice, occ_s, gbufs
+                )
+                new_tables = {
+                    name: self.optimizer.update_rows(table, gbufs[name])
+                    for name, table in tables_c.items()
+                }
             new_dense = self._apply_dense_sgd(dense_c, gd)
             nll_c = nll_c + logloss_sum(
                 bslice["labels"], pctr_s, bslice["weights"]

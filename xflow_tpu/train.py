@@ -87,6 +87,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--hot-dtype", choices=["float32", "bfloat16"], dest="hot_dtype"
     )
     p.add_argument(
+        "--sequential-inner", dest="sequential_inner",
+        choices=["dense", "sparse"],
+        help="per-slice update strategy under --update-mode sequential: "
+        "dense = full-table pass (T<=2^24); sparse = touched-rows only "
+        "(required at 2^28-scale tables)",
+    )
+    p.add_argument(
         "--cold-consolidate", action="store_true", default=None,
         dest="cold_consolidate",
         help="merge duplicate cold keys (shared argsort + segment-sum) "
