@@ -100,6 +100,8 @@ def run_one(name: str, args) -> None:
         over["hot_dtype"] = args.hot_dtype
     if args.microbatch is not None:
         over["microbatch"] = args.microbatch
+    if args.cold_consolidate:
+        over["cold_consolidate"] = True
     if over:
         cfg = cfg.replace(**over)
     csr = remap = None
@@ -139,6 +141,8 @@ def run_one(name: str, args) -> None:
             "table_size_log2": cfg.table_size_log2,
             "hot": f"2^{cfg.hot_size_log2}x{cfg.hot_nnz}+cold{cfg.max_nnz}"
             if cfg.hot_size else "off",
+            "cold_consolidate": cfg.cold_consolidate,
+            "hot_dtype": cfg.hot_dtype,
             "backend": backend or "cpu",
             "batch_source": source,
             "wall_s": round(time.time() - t0, 1),
@@ -175,13 +179,15 @@ def main() -> None:
     ap.add_argument("--hot-dtype", default=None,
                     choices=["float32", "bfloat16"])
     ap.add_argument("--microbatch", type=int, default=None)
+    ap.add_argument("--cold-consolidate", action="store_true",
+                    dest="cold_consolidate")
     args = ap.parse_args()
 
     if args.model is not None:
         run_one(args.model, args)
         return
 
-    if any(
+    if args.cold_consolidate or any(
         v is not None
         for v in (args.hot_log2, args.hot_nnz, args.cold_nnz,
                   args.hot_dtype, args.microbatch)

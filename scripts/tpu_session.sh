@@ -1,0 +1,72 @@
+#!/bin/bash
+# Round-5 TPU measurement queue — run when the tunnel is healthy:
+#     bash scripts/tpu_session.sh [outdir]
+#
+# Runs the full evidence list in priority order, flushing each result
+# to its own file the moment it lands (the tunnel dies without
+# warning — docs/PERF.md).  NO timeouts around TPU-bound processes:
+# killing one wedges the chip lease for every later client (verify
+# skill notes).  Priorities:
+#   1. bench.py             -> flagship artifact (BENCH + docs/artifacts)
+#   2. time_to_auc lr       -> the north-star >=5x wall-clock-to-AUC
+#   3. time_to_auc flagship -> full-protocol path-parity overlay
+#   4. probe_consolidate    -> is the argsort worth the saved slices?
+#   5. bench_models sweeps  -> D>1 hot-head scaling + cold_consolidate
+#   6. time_to_auc t28      -> B_eff=512 at the north-star table
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_r5}"
+mkdir -p "$OUT"
+log() { echo "[$(date -u +%H:%M:%S)] $*"; }
+
+log "1/6 bench.py (flagship)"
+python bench.py >"$OUT/bench.json" 2>"$OUT/bench.err"
+tail -c 400 "$OUT/bench.json"
+
+log "2/6 time_to_auc lr (plain path, the north-star artifact)"
+python scripts/time_to_auc.py --model lr \
+    >"$OUT/ttauc_lr.out" 2>"$OUT/ttauc_lr.err"
+tail -2 "$OUT/ttauc_lr.out"
+
+log "3/6 time_to_auc lr flagship path (full-protocol overlay)"
+python scripts/time_to_auc.py --model lr \
+    --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
+    --out docs/artifacts/time_to_auc_lr_flagship.json \
+    >"$OUT/ttauc_lr_flag.out" 2>"$OUT/ttauc_lr_flag.err"
+tail -2 "$OUT/ttauc_lr_flag.out"
+
+log "4/6 probe_consolidate"
+python scripts/probe_consolidate.py \
+    >"$OUT/probe_consolidate.out" 2>"$OUT/probe_consolidate.err"
+cat "$OUT/probe_consolidate.out"
+
+log "5/6 bench_models: baseline + D>1 sweeps"
+python scripts/bench_models.py --batch-log2 17 \
+    >"$OUT/models_base.out" 2>"$OUT/models_base.err"
+for m in fm mvm wide_deep; do
+  for h in 14 15 16; do
+    python scripts/bench_models.py --model "$m" --batch-log2 17 \
+        --hot-log2 "$h" \
+        >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+    python scripts/bench_models.py --model "$m" --batch-log2 17 \
+        --hot-log2 "$h" --cold-consolidate \
+        >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+  done
+  python scripts/bench_models.py --model "$m" --batch-log2 17 \
+      --hot-log2 14 --hot-dtype bfloat16 \
+      >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+done
+# FFM: no hot geometry fits its 156-wide rows; measure consolidation
+python scripts/bench_models.py --model ffm --batch-log2 17 \
+    --cold-consolidate \
+    >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+tail -5 "$OUT/models_sweep.out"
+
+log "6/6 time_to_auc t28 sparse inner (north-star table)"
+python scripts/time_to_auc.py --model lr --table-size-log2 28 \
+    --sequential-inner sparse --max-epochs 2 --target-auc 0.99 \
+    --out docs/artifacts/time_to_auc_lr_t28.json \
+    >"$OUT/ttauc_t28.out" 2>"$OUT/ttauc_t28.err"
+tail -2 "$OUT/ttauc_t28.out"
+
+log "queue complete — results in $OUT and docs/artifacts/"

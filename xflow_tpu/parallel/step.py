@@ -453,7 +453,13 @@ class TrainStep:
         dense = state["dense"]
         num_real = jnp.maximum(jnp.sum(batch["weights"]), 1.0)
 
-        if cfg.update_mode == "sparse":
+        # sequential with one slice degenerates to a single whole-batch
+        # update; honor the configured inner so a sparse-inner run at
+        # microbatch=1 doesn't silently pay a full-table dense pass
+        if cfg.update_mode == "sparse" or (
+            cfg.update_mode == "sequential"
+            and cfg.sequential_inner == "sparse"
+        ):
             pctr, occ_grads, grad_dense = self._forward_grads(
                 tables, dense, batch, num_real
             )
