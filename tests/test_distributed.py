@@ -72,10 +72,13 @@ def test_two_process_training(toy_dataset, tmp_path, hot):
         "--num-processes", "2",
     ]
     if hot:
-        # compose the hot-table MXU path AND the gradient-accumulation
-        # scan with real 2-process collectives in one parametrization
+        # compose the hot-table MXU path AND the sequential per-slice
+        # update scan with real 2-process collectives in one
+        # parametrization (the accumulate scan's sharding is covered by
+        # test_dense_sharded_matches_single on the 8-device mesh)
         cmd += ["--hot-size-log2", "8", "--hot-nnz", "8",
-                "--freq-sample-mib", "1", "--microbatch", "2"]
+                "--freq-sample-mib", "1", "--microbatch", "2",
+                "--update-mode", "sequential"]
     else:
         # cover the multi-host checkpoint path (collective allgather
         # save, rank-0 writes) in one of the parametrizations
