@@ -60,7 +60,16 @@ done
 python scripts/bench_models.py --model ffm --batch-log2 17 \
     --cold-consolidate \
     >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
-tail -5 "$OUT/models_sweep.out"
+# LR flagship neighbors: resolve round-4's interpolated flagship row
+# with direct measurements (cold 12 — cold 16 IS the step-5 baseline
+# lr row — and bf16 hot)
+python scripts/bench_models.py --model lr --batch-log2 17 \
+    --hot-log2 12 --cold-nnz 12 \
+    >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+python scripts/bench_models.py --model lr --batch-log2 17 \
+    --hot-log2 12 --hot-dtype bfloat16 \
+    >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+tail -8 "$OUT/models_sweep.out"
 
 log "6/6 time_to_auc t28 sparse inner (north-star table)"
 python scripts/time_to_auc.py --model lr --table-size-log2 28 \
