@@ -640,6 +640,41 @@ def _finalize_artifact(result: dict, force_cpu: bool, accel_eps) -> None:
                 result["last_good_artifact"] = os.path.join(
                     "docs", "artifacts", os.path.basename(good[-1])
                 )
+            else:
+                # no per-run artifact yet: fall back to the newest
+                # committed round artifact that ran on an accelerator
+                repo = os.path.dirname(os.path.abspath(__file__))
+                for rnd in sorted(
+                    _glob.glob(os.path.join(repo, "BENCH_r*.json")),
+                    reverse=True,
+                ):
+                    # driver wrapper: the extracted bench object lives
+                    # in "parsed"; fall back to scanning "tail" for
+                    # pre-"parsed" wrappers (guard json.loads per line —
+                    # a truncated second brace-line must not discard an
+                    # already-found valid metric object)
+                    try:
+                        with open(rnd) as f:
+                            wrapper = json.load(f)
+                    except (OSError, ValueError):
+                        continue
+                    prev = wrapper.get("parsed")
+                    if not isinstance(prev, dict):
+                        prev = None
+                        for line in str(wrapper.get("tail", "")).splitlines():
+                            line = line.strip()
+                            if line.startswith("{") and "metric" in line:
+                                try:
+                                    prev = json.loads(line)
+                                except ValueError:
+                                    continue
+                    if prev and prev.get("backend") not in (
+                        None, "cpu", "unknown",
+                    ):
+                        result["last_good_artifact"] = os.path.basename(
+                            rnd
+                        )
+                        break
         except OSError:
             pass
     elif accel_eps is not None:
