@@ -16,10 +16,14 @@ Composes the two halves the repo previously measured separately:
 
 The dataset is staged into device HBM ONCE as compact-wire planes
 (~1.6 GB for 10 M examples at 40 keys/row — int32 keys + u8
-labels/weights), so the timed training loop reads batches with an
-on-device dynamic_slice instead of paying the tunneled host↔device
-link (~150-250 MB/s, docs/PERF.md) every step.  Staging time is
-reported separately and included in the total.
+labels/weights), so the training loop reads device-resident windows
+instead of paying the tunneled host↔device link every step.  The
+clock starts BEFORE staging: uploads are enqueued as per-window async
+transfers and epoch-0 compute overlaps the transfer stream, so
+wall-to-target (secs_to_target_auc) pays the upload honestly without
+serializing on it.  Compile time is reported separately AND added
+into total_wall_secs / the headline speedup (a persistent XLA
+compilation cache makes it ~1 s on repeat runs of a geometry).
 
 Usage (full protocol, after gen_synth + binary conversion — see
 scripts/convergence_baseline.py header for the dataset recipe):
@@ -133,6 +137,15 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    # persistent XLA compilation cache: repeat runs of the same
+    # geometry skip the ~14 s trace+compile (reported separately
+    # either way, so the artifact shows which case it was)
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("XFLOW_JAX_CACHE", "/tmp/xflow_jaxcache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from xflow_tpu.config import Config
     from xflow_tpu.trainer import Trainer
     from xflow_tpu.utils.metrics import AucAccumulator
@@ -211,46 +224,30 @@ def main():
 
     train_planes, n_train = pad_planes(train_planes, B)
     test_planes, n_test = pad_planes(test_planes, B)
-
-    # device staging, timed — the one-time cost device residency buys out
-    t_stage0 = time.time()
-    train_dev = {k: jnp.asarray(v) for k, v in train_planes.items()}
-    test_dev = {k: jnp.asarray(v) for k, v in test_planes.items()}
-    jax.block_until_ready(list(train_dev.values()) + list(test_dev.values()))
-    # platform gotcha: block_until_ready can return early here — sync
-    # with a device_get of a slice
-    jax.device_get(train_dev["labels_u8"][:1])
-    stage_secs = time.time() - t_stage0
+    n_padded = len(train_planes["labels_u8"])
+    n_windows = n_padded // B
     bytes_staged = sum(
         v.nbytes for v in list(train_planes.values()) + list(test_planes.values())
     )
 
     step = trainer.step
 
-    def slice_batch(data, start):
-        return {
-            k: jax.lax.dynamic_slice_in_dim(v, start, B) for k, v in data.items()
-        }
-
     run_chunk = jax.jit(
-        lambda state, data, start: step._train_impl(
-            state, slice_batch(data, start)
-        ),
+        lambda state, window: step._train_impl(state, window),
         donate_argnums=0,
     )
     predict_chunk = jax.jit(
-        lambda state, data, start: step._predict_impl(
-            state, slice_batch(data, start)
-        )
+        lambda state, window: step._predict_impl(state, window)
     )
 
-    def evaluate(state):
+    def window_of(planes, i):
+        return {k: v[i * B : (i + 1) * B] for k, v in planes.items()}
+
+    def evaluate(state, test_dev):
         acc = AucAccumulator()
-        for start in range(0, len(test_planes["labels_u8"]), B):
-            pctr = np.asarray(
-                jax.device_get(predict_chunk(state, test_dev, start))
-            )
-            sl = slice(start, start + B)
+        for i, win in enumerate(test_dev):
+            pctr = np.asarray(jax.device_get(predict_chunk(state, win)))
+            sl = slice(i * B, (i + 1) * B)
             acc.add(
                 test_planes["labels_u8"][sl].astype(np.float32),
                 pctr,
@@ -259,12 +256,18 @@ def main():
         ll, auc = acc.compute()
         return ll, auc
 
-    # compile outside the timed region (one-time, reported separately)
+    # compile on a zero-filled dummy window BEFORE any real data is
+    # staged (one-time, reported separately; persistent-cache hits
+    # make this ~1 s on repeat runs of the same geometry)
     t_c0 = time.time()
+    dummy = {
+        k: jnp.zeros((B,) + v.shape[1:], v.dtype)
+        for k, v in train_planes.items()
+    }
     state = trainer.state
-    state, m = run_chunk(state, train_dev, 0)
+    state, m = run_chunk(state, dummy)
     jax.device_get(m["logloss"])
-    jax.device_get(predict_chunk(state, test_dev, 0)[:1])
+    jax.device_get(predict_chunk(state, dummy)[:1])
     compile_secs = time.time() - t_c0
     # rebuild pristine state (the compile probe trained one window)
     from xflow_tpu.parallel.step import init_state
@@ -273,17 +276,22 @@ def main():
 
     result = {
         "model": args.model,
+        # v2 = overlapped staging inside the timed region, headline
+        # speedup = baseline / (secs_to_target + compile); v1
+        # artifacts (no accounting key) timed staging separately and
+        # divided by total+stage+compile
+        "accounting": "v2-overlapped-staging",
         "protocol": "docs/CONVERGENCE.md (B_eff=%d, ftrl.h:17-20 "
         "hyperparameters, T=2^%d)" % (args.eff_batch, args.table_size_log2),
         "backend": jax.devices()[0].platform,
         "batch_size": B,
         "eff_batch": args.eff_batch,
         "microbatch": cfg.microbatch,
+        "sequential_inner": cfg.sequential_inner,
         "hot_size_log2": args.hot_size_log2,
         "n_train": n_train,
         "n_test": n_test,
         "host_prep_secs": round(host_prep_secs, 2),
-        "device_stage_secs": round(stage_secs, 2),
         "bytes_staged": bytes_staged,
         "compile_secs": round(compile_secs, 2),
         "target_auc": args.target_auc,
@@ -291,21 +299,51 @@ def main():
         "epochs": [],
     }
 
-    n_padded = len(train_planes["labels_u8"])
+    # The clock starts BEFORE device staging: wall-to-target pays the
+    # full host→device upload honestly.  Uploads are enqueued as
+    # per-window async transfers (jnp.asarray returns before the copy
+    # lands), so epoch-0 compute overlaps the tail of the transfer
+    # stream instead of waiting for all of it.  Staging is therefore
+    # NOT a separable wall-clock term: upload_enqueue_secs is the host
+    # dispatch cost alone; uploads_verified_by_wall_secs the wall
+    # offset by which every transfer was VERIFIED landed (upper bound
+    # — the check runs after epoch-0 compute).
     t0 = time.time()
+    train_dev = [
+        {k: jnp.asarray(v) for k, v in window_of(train_planes, i).items()}
+        for i in range(n_windows)
+    ]
+    test_dev = [
+        {k: jnp.asarray(v) for k, v in window_of(test_planes, i).items()}
+        for i in range(len(test_planes["labels_u8"]) // B)
+    ]
+    result["upload_enqueue_secs"] = round(time.time() - t0, 2)
     reached = None
     for epoch in range(args.max_epochs):
         t_ep = time.time()
         ll_sum = cnt = 0.0
         metrics = []
-        for start in range(0, n_padded, B):
-            state, m = run_chunk(state, train_dev, start)
+        for win in train_dev:
+            state, m = run_chunk(state, win)
             metrics.append(m)
         for m in jax.device_get(metrics):
             ll_sum += float(m["logloss"]) * float(m["count"])
             cnt += float(m["count"])
         train_secs = time.time() - t_ep
-        ev_ll, ev_auc = evaluate(state)
+        if epoch == 0:
+            # verify every transfer landed — device_get, NOT
+            # block_until_ready, which returns early on this tunneled
+            # platform (verify-skill gotcha); transfers were enqueued
+            # in order on one stream, but touch one element of every
+            # test window rather than assume ordering.  UPPER BOUND:
+            # checked after epoch-0 compute, so this records "landed
+            # by here", not the landing instant.
+            for w in test_dev:
+                jax.device_get(w["labels_u8"][:1])
+            result["uploads_verified_by_wall_secs"] = round(
+                time.time() - t0, 2
+            )
+        ev_ll, ev_auc = evaluate(state, test_dev)
         wall = time.time() - t0
         row = {
             "epoch": epoch,
@@ -324,15 +362,15 @@ def main():
             break
 
     total = time.time() - t0
-    result["train_eval_wall_secs"] = round(total, 2)
-    result["total_wall_secs"] = round(
-        total + stage_secs + compile_secs, 2
-    )
+    # timed region = staging + train + eval (staging overlaps epoch 0
+    # and is not separable); compile is added back for the headline
+    result["stage_train_eval_wall_secs"] = round(total, 2)
+    result["total_wall_secs"] = round(total + compile_secs, 2)
     if reached is not None and result["cpu_baseline_secs"]:
         result["speedup_vs_cpu_baseline"] = round(
-            result["cpu_baseline_secs"] / result["total_wall_secs"], 2
+            result["cpu_baseline_secs"] / (reached + compile_secs), 2
         )
-        result["speedup_train_eval_only"] = round(
+        result["speedup_excl_compile"] = round(
             result["cpu_baseline_secs"] / reached, 2
         )
     out = args.out or os.path.join(
