@@ -1,0 +1,84 @@
+#!/bin/bash
+# Round-5 TPU measurement queue, part 2 — the first session captured
+# the flagship bench artifact (3.09 M ex/s, 7.64x, bench_tpu_*.json),
+# the plain-path wall-to-AUC (232.8 s train+eval to 0.7401) and the
+# flagship-path parity overlay, then the tunnel died during the D>1
+# sweeps.  This queue holds what remains, re-prioritized:
+#   - cold-consolidate sweeps are DROPPED: probe_consolidate measured
+#     the consolidated scatter 2x SLOWER than plain on TPU (497 ms vs
+#     239 ms at dup_frac 0.92) — negative result recorded in PERF.md.
+#   - the headline attempt is now sequential_inner=sparse, measured
+#     17x faster per window than the dense inner on CPU (the dense
+#     inner streams the full 2^24 table per 512-example slice).
+# Run when the tunnel is healthy: bash scripts/tpu_session2.sh [outdir]
+# NO timeouts around TPU-bound processes (verify skill: killing one
+# wedges the chip lease).
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_r5b}"
+mkdir -p "$OUT"
+log() { echo "[$(date -u +%H:%M:%S)] $*"; }
+
+log "1/6 time_to_auc lr, sparse inner (headline north-star attempt)"
+python scripts/time_to_auc.py --model lr --sequential-inner sparse \
+    --out docs/artifacts/time_to_auc_lr_sparse.json \
+    >"$OUT/ttauc_sparse.out" 2>"$OUT/ttauc_sparse.err"
+tail -2 "$OUT/ttauc_sparse.out"
+
+log "2/6 lr flagship neighbors (resolve the interpolated flagship row)"
+python scripts/bench_models.py --model lr --batch-log2 17 \
+    --hot-log2 12 --cold-nnz 12 \
+    >>"$OUT/lr_neighbors.out" 2>>"$OUT/lr_neighbors.err"
+python scripts/bench_models.py --model lr --batch-log2 17 \
+    --hot-log2 12 --hot-dtype bfloat16 \
+    >>"$OUT/lr_neighbors.out" 2>>"$OUT/lr_neighbors.err"
+tail -2 "$OUT/lr_neighbors.out"
+
+log "3/6 D>1 hot-head scaling: fm/mvm/wide_deep hot {15,16} + bf16"
+for m in fm mvm wide_deep; do
+  for h in 15 16; do
+    python scripts/bench_models.py --model "$m" --batch-log2 17 \
+        --hot-log2 "$h" \
+        >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+  done
+  python scripts/bench_models.py --model "$m" --batch-log2 17 \
+      --hot-log2 14 --hot-dtype bfloat16 \
+      >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+done
+tail -9 "$OUT/models_sweep.out"
+
+log "4/6 reference-shaped e2e on TPU: CLI train over packed cache + ckpt + resume"
+rm -rf /tmp/ck_tpu /tmp/pred_tpu.txt
+python -m xflow_tpu.train --model lr \
+    --train /tmp/xflow_conv/bin.train --test /tmp/xflow_conv/bin.test \
+    --epochs 2 --batch-size 131072 --table-size-log2 24 --max-nnz 40 \
+    --hot-size-log2 12 --hot-nnz 32 --num-devices 1 \
+    --checkpoint-dir /tmp/ck_tpu --metrics-out "$OUT/e2e_train_metrics.jsonl" \
+    >"$OUT/e2e_train.out" 2>"$OUT/e2e_train.err"
+tail -3 "$OUT/e2e_train.out"
+python -m xflow_tpu.train --model lr \
+    --train /tmp/xflow_conv/bin.train --test /tmp/xflow_conv/bin.test \
+    --epochs 3 --batch-size 131072 --table-size-log2 24 --max-nnz 40 \
+    --hot-size-log2 12 --hot-nnz 32 --num-devices 1 \
+    --checkpoint-dir /tmp/ck_tpu --resume \
+    >"$OUT/e2e_resume.out" 2>"$OUT/e2e_resume.err"
+tail -3 "$OUT/e2e_resume.out"
+
+log "5/6 time_to_auc t28 sparse inner (north-star table)"
+python scripts/time_to_auc.py --model lr --table-size-log2 28 \
+    --sequential-inner sparse --max-epochs 2 --target-auc 0.99 \
+    --out docs/artifacts/time_to_auc_lr_t28.json \
+    >"$OUT/ttauc_t28.out" 2>"$OUT/ttauc_t28.err"
+tail -2 "$OUT/ttauc_t28.out"
+
+log "6/6 wall-to-AUC for the D>1 families, sparse inner (fm, mvm)"
+python scripts/time_to_auc.py --model fm --sequential-inner sparse \
+    --out docs/artifacts/time_to_auc_fm_sparse.json \
+    >"$OUT/ttauc_fm.out" 2>"$OUT/ttauc_fm.err"
+tail -1 "$OUT/ttauc_fm.out"
+python scripts/time_to_auc.py --model mvm --sequential-inner sparse \
+    --out docs/artifacts/time_to_auc_mvm_sparse.json \
+    >"$OUT/ttauc_mvm.out" 2>"$OUT/ttauc_mvm.err"
+tail -1 "$OUT/ttauc_mvm.out"
+
+log "queue complete — results in $OUT and docs/artifacts/"

@@ -102,8 +102,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--wire-mode", choices=["auto", "full", "compact"], dest="wire_mode",
-        help="host->device batch format; compact ships ~4x fewer bytes "
-        "(hash-mode lr/fm only)",
+        help="host->device batch format; compact ships ~16x fewer "
+        "bytes/entry (hash mode; slot-reading models add a u8 slots "
+        "plane, ~3x)",
     )
     p.add_argument("--pred-out", dest="pred_out")
     p.add_argument(
