@@ -25,6 +25,13 @@ python scripts/time_to_auc.py --model lr --sequential-inner sparse \
     >"$OUT/ttauc_sparse.out" 2>"$OUT/ttauc_sparse.err"
 tail -2 "$OUT/ttauc_sparse.out"
 
+log "1b/6 time_to_auc lr, HYBRID sparse inner + flagship hot geometry"
+python scripts/time_to_auc.py --model lr --sequential-inner sparse \
+    --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
+    --out docs/artifacts/time_to_auc_lr_sparse_flagship.json \
+    >"$OUT/ttauc_sparse_flag.out" 2>"$OUT/ttauc_sparse_flag.err"
+tail -2 "$OUT/ttauc_sparse_flag.out"
+
 log "2/6 lr flagship neighbors (resolve the interpolated flagship row)"
 python scripts/bench_models.py --model lr --batch-log2 17 \
     --hot-log2 12 --cold-nnz 12 \

@@ -204,19 +204,72 @@ def test_sequential_sparse_inner_equals_dense_inner(model):
         )
 
 
-@pytest.mark.parametrize("inner", ["dense", "sparse"])
-def test_sequential_microbatch_one_is_dense(inner):
+@pytest.mark.parametrize("model", ["lr", "fm"])
+def test_sequential_sparse_inner_hybrid_hot(model):
+    """sparse inner + hot table (the hybrid, step.py::_sparse_update):
+    cold keys keep the touched-rows path, the hot section gets a dense
+    [H, D] head update, and hot rows that ALSO arrive through the cold
+    planes (split_hot overflow spill) are folded into the hot buffer so
+    every row sees exactly one summed-gradient update — the same
+    training as the dense inner."""
+    rng = np.random.default_rng(17)
+    keys, slots, vals, mask, labels, weights = rand_batch(rng, B)
+    # force heavy hot-head traffic incl. per-row overflow: half the
+    # columns draw from hot rows [0, 16), so rows carry more hot keys
+    # than hot_nnz=4 and the excess spills into the cold planes with
+    # row ids < H — the exactly-once case the hybrid must fold in
+    keys[:, ::2] = rng.integers(0, 16, (B, (K + 1) // 2)).astype(np.int32)
+    raw = (keys, slots, vals, mask, labels, weights)
+    hot_size, hot_nnz = 1 << 8, 4
+    out = {}
+    for inner in ("dense", "sparse"):
+        cfg = base_cfg(
+            model,
+            update_mode="sequential",
+            microbatch=M,
+            sequential_inner=inner,
+            hot_size_log2=8,
+            hot_nnz=hot_nnz,
+        )
+        step, state = build(model, cfg)
+        state, _ = step.train(
+            state, step.put_batch(make_batch(*raw, hot_size, hot_nnz))
+        )
+        out[inner] = jax.device_get(state)
+    for name in out["dense"]["tables"]:
+        for part in out["dense"]["tables"][name]:
+            np.testing.assert_allclose(
+                np.asarray(out["sparse"]["tables"][name][part]),
+                np.asarray(out["dense"]["tables"][name][part]),
+                rtol=1e-5,
+                atol=1e-7,
+                err_msg=f"{model}:{name}/{part}",
+            )
+
+
+@pytest.mark.parametrize(
+    "inner,hot",
+    [("dense", False), ("sparse", False), ("sparse", True)],
+)
+def test_sequential_microbatch_one_is_dense(inner, hot):
     """microbatch=1 degenerates to a single whole-batch update — via
     the dense pass or, with sequential_inner='sparse', the
     touched-rows-only path (which must not silently fall through to a
-    full-table pass at north-star table sizes)."""
+    full-table pass at north-star table sizes).  The hot-on case pins
+    the degenerate path of the hybrid inner."""
     rng = np.random.default_rng(5)
     raw = rand_batch(rng, B)
+    hot_kw = {"hot_size_log2": 8, "hot_nnz": 4} if hot else {}
+    hot_args = (1 << 8, 4) if hot else ()
     states = {}
     for mode in ("sequential", "dense"):
-        cfg = base_cfg("lr", update_mode=mode, sequential_inner=inner)
+        cfg = base_cfg(
+            "lr", update_mode=mode, sequential_inner=inner, **hot_kw
+        )
         step, state = build("lr", cfg)
-        state, _ = step.train(state, step.put_batch(make_batch(*raw)))
+        state, _ = step.train(
+            state, step.put_batch(make_batch(*raw, *hot_args))
+        )
         states[mode] = np.asarray(
             jax.device_get(state["tables"]["w"]["param"])
         )

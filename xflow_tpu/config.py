@@ -160,8 +160,10 @@ class Config:
     #   only touched rows; O(slice nnz) per slice, the ONLY viable form
     #   at north-star table sizes (a 2^28 FTRL triple is ~3 GiB —
     #   a full pass per 512-example slice would stream ~7 GiB).
-    #   Requires hot table off (the hot path accumulates into a dense
-    #   buffer).  Equivalence: tests/test_sequential.py.
+    #   With the hot table on this runs the hybrid inner: cold keys
+    #   touched-rows, hot section a dense [H, D] update with overflow
+    #   spill folded in exactly once (step.py::_sparse_update).
+    #   Equivalence: tests/test_sequential.py.
     sequential_inner: str = "dense"  # {"dense", "sparse"}
 
     # Gradient-accumulation slices per train step (1 = off).  The batch
@@ -257,15 +259,6 @@ class Config:
         if self.sequential_inner not in ("dense", "sparse"):
             raise ValueError(
                 f"unknown sequential_inner {self.sequential_inner!r}"
-            )
-        if (
-            self.sequential_inner == "sparse"
-            and self.update_mode == "sequential"
-            and self.hot_size_log2
-        ):
-            raise ValueError(
-                "sequential_inner='sparse' requires the hot table off "
-                "(the hot path accumulates into a dense buffer)"
             )
         if self.cold_consolidate and self.update_mode not in (
             "dense",

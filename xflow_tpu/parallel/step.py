@@ -410,11 +410,7 @@ class TrainStep:
         if kh:
             from xflow_tpu.ops.hot import hot_scatter
 
-            hot_keys_eff = jnp.where(
-                batch["hot_mask"] > 0,
-                batch["hot_keys"],
-                jnp.int32(cfg.hot_size),
-            ).reshape(-1)
+            hot_keys_eff = self._hot_keys_eff(batch)
         out = {}
         for name, table in tables.items():
             d = table["param"].shape[-1]
@@ -463,8 +459,8 @@ class TrainStep:
             pctr, occ_grads, grad_dense = self._forward_grads(
                 tables, dense, batch, num_real
             )
-            kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
-            assert not kh, "hot table requires dense mode (config checks)"
+            # hot planes, when present, take _sparse_update's hybrid
+            # path (dense [H, D] head update, overflow fold)
             new_tables = self._sparse_update(tables, batch, occ_grads)
             ll = logloss(batch["labels"], pctr, batch["weights"])
             cnt = jnp.sum(batch["weights"])
@@ -528,6 +524,18 @@ class TrainStep:
             state, new_tables, dense, grad_dense, ll, cnt
         )
 
+    def _hot_keys_eff(self, batch: BatchArrays) -> jax.Array:
+        """Sentinel-coded flat hot keys: masked slots → H, which both
+        the dense path's hot_scatter and the hybrid's [H, D] fold drop
+        as out-of-range.  The ONE definition of the hot sentinel
+        convention, shared by _scatter_grads and _sparse_update so the
+        dense and hybrid update paths cannot drift."""
+        return jnp.where(
+            batch["hot_mask"] > 0,
+            batch["hot_keys"],
+            jnp.int32(self.cfg.hot_size),
+        ).reshape(-1)
+
     def _sparse_update(
         self, tables: dict, batch: BatchArrays, occ_grads: dict
     ) -> dict:
@@ -536,8 +544,21 @@ class TrainStep:
         state rows, run the recurrence, scatter back.  Shared by the
         sparse update mode (whole batch) and sequential mode's sparse
         inner (per slice — the only viable per-slice form at
-        north-star table sizes)."""
+        north-star table sizes).
+
+        With the hot table on, this becomes a HYBRID: cold keys keep
+        the touched-rows path while the hot section's gradients ride
+        the MXU into a dense [H, D] buffer whose rows get one dense
+        optimizer pass (H rows ≈ 115 KB of traffic — negligible next
+        to a [T, D] full-table pass).  Exactly-once semantics: hot
+        rows can ALSO appear among the cold keys (split_hot overflow
+        spill, io/batch.py:89-93), so cold contributions to rows
+        < H are folded into the hot gradient buffer and masked out of
+        the sparse scatter — every row sees ONE summed-gradient
+        update, matching the dense path's gbuf semantics bit-for-bit
+        in structure."""
         cfg = self.cfg
+        kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
         sentinel = jnp.int32(cfg.table_size)
         keys_eff = jnp.where(
             batch["mask"] > 0, batch["keys"], sentinel
@@ -545,20 +566,49 @@ class TrainStep:
         # one shared argsort; every table's gradients ride the same
         # permutation/segments (same sharing as _scatter_grads)
         order, seg, ukeys = consolidate_plan(keys_eff, cfg.table_size)
+        if kh:
+            from xflow_tpu.ops.hot import hot_scatter
+
+            hsize = cfg.hot_size
+            hot_keys_eff = self._hot_keys_eff(batch)
+            in_hot = ukeys < hsize
+            ukeys_cold = jnp.where(in_hot, sentinel, ukeys)
+            # consolidated cold sums destined for hot rows; index H
+            # (out of range for the [H, D] buffer) drops the rest
+            ukeys_hotpart = jnp.where(in_hot, ukeys, jnp.int32(hsize))
+        else:
+            ukeys_cold = ukeys
         new_tables = {}
         for name, table in tables.items():
             d = table["param"].shape[-1]
-            gsum = consolidate_apply(
-                occ_grads[name].reshape(-1, d), order, seg
-            )
+            occ = occ_grads[name]
+            if kh:
+                hot_g = occ[:, :kh].reshape(-1, d)
+                occ = occ[:, kh:]
+            gsum = consolidate_apply(occ.reshape(-1, d), order, seg)
             state_rows = {
-                k: gather_rows(arr, ukeys) for k, arr in table.items()
+                k: gather_rows(arr, ukeys_cold) for k, arr in table.items()
             }
             new_rows = self.optimizer.update_rows(state_rows, gsum)
-            new_tables[name] = {
-                k: scatter_rows(table[k], ukeys, new_rows[k])
+            new = {
+                k: scatter_rows(table[k], ukeys_cold, new_rows[k])
                 for k in table.keys()
             }
+            if kh:
+                ghot = hot_scatter(
+                    hot_keys_eff, hot_g, hsize, dtype=self._hot_dtype
+                )
+                # non-hot slots carry index H -> dropped; no mask needed
+                ghot = ghot.at[ukeys_hotpart].add(gsum, mode="drop")
+                hot_rows = {k: arr[:hsize] for k, arr in new.items()}
+                new_hot = self.optimizer.update_rows(hot_rows, ghot)
+                new = {
+                    k: jax.lax.dynamic_update_slice_in_dim(
+                        new[k], new_hot[k], 0, axis=0
+                    )
+                    for k in new
+                }
+            new_tables[name] = new
         return new_tables
 
     def _train_sequential(
