@@ -80,7 +80,9 @@ def stage_planes(trainer, path, cache_tag, limit=0):
     for shard in find_shards(path):
         for batch, _ in trainer._loader(shard).iter_batches():
             wire = compact_wire_np(
-                batch, ship_slots=trainer.step._ship_slots
+                batch,
+                ship_slots=trainer.step._ship_slots,
+                hot_u16=trainer.step._hot_u16,
             )
             for k, v in wire.items():
                 planes.setdefault(k, []).append(v)
@@ -179,13 +181,14 @@ def main():
     # size, hot geometry, cold capacity, batch padding, and whether a
     # slots plane is shipped (slot models on a slot-free cache would
     # silently train every feature in field 0)
-    tag = "ttauc-t{}-h{}-hn{}-c{}-b{}-s{}".format(
+    tag = "ttauc-t{}-h{}-hn{}-c{}-b{}-s{}{}".format(
         args.table_size_log2,
         args.hot_size_log2 if args.hot_size_log2 else 0,
         args.hot_nnz if args.hot_size_log2 else 0,
         args.max_nnz,
         args.batch_size,
         int(trainer.step._ship_slots),
+        "-w2" if trainer.step._hot_u16 else "",
     )
     t_setup0 = time.time()
     train_planes = stage_planes(trainer, args.train, tag, args.examples)
@@ -213,11 +216,13 @@ def main():
             return planes, n
         out = {}
         for k, v in planes.items():
-            fill = np.full(
-                (pad,) + v.shape[1:],
-                -1 if k.endswith("ckeys") else 0,
-                v.dtype,
-            )
+            if k.endswith("ckeys_u16"):
+                fill_val = 0xFFFF  # the u16 pad sentinel
+            elif k.endswith("ckeys"):
+                fill_val = -1
+            else:
+                fill_val = 0
+            fill = np.full((pad,) + v.shape[1:], fill_val, v.dtype)
             out[k] = np.concatenate([v, fill])
         # padding examples carry weight 0 -> no gradient, no metric
         return out, n

@@ -167,3 +167,66 @@ def test_compact_guards_value_batches():
     )
     with pytest.raises(ValueError, match="0/1"):
         batch_to_compact(b2)
+
+
+def test_hot_u16_plane_halves_and_roundtrips():
+    """hot_u16 compact wire: the hot-keys plane ships as uint16
+    (sentinel 0xFFFF — legal for H <= 2^15, ids can't reach it) at
+    half the int32 plane's bytes, and _expand_wire reconstructs
+    keys/mask/vals identically to the int32 plane."""
+    import jax.numpy as jnp
+
+    from xflow_tpu.io.batch import make_batch
+    from xflow_tpu.models import make_model
+    from xflow_tpu.optim import make_optimizer
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.parallel.step import TrainStep, compact_wire_np
+
+    rng = np.random.default_rng(23)
+    b, k = 32, 12
+    keys = rng.integers(0, 1 << 12, (b, k)).astype(np.int32)
+    keys[:, ::2] = rng.integers(0, 16, (b, 6)).astype(np.int32)  # hot
+    slots = rng.integers(0, 8, (b, k)).astype(np.int32)
+    mask = (rng.uniform(size=(b, k)) < 0.8).astype(np.float32)
+    vals = mask.copy()  # hash mode: vals == 1 on real entries
+    labels = (rng.uniform(size=b) < 0.4).astype(np.float32)
+    weights = np.ones(b, np.float32)
+    batch = make_batch(keys, slots, vals, mask, labels, weights, 1 << 8, 4)
+
+    w16 = compact_wire_np(batch, hot_u16=True)
+    w32 = compact_wire_np(batch, hot_u16=False)
+    assert w16["hot_ckeys_u16"].dtype == np.uint16
+    assert w16["hot_ckeys_u16"].nbytes * 2 == w32["hot_ckeys"].nbytes
+
+    cfg = Config(
+        model="lr", batch_size=b, table_size_log2=12, max_nnz=k,
+        max_fields=8, num_devices=1, hot_size_log2=8, hot_nnz=4,
+    )
+    step = TrainStep(
+        make_model(cfg), make_optimizer(cfg), cfg, make_mesh(1)
+    )
+    assert step._hot_u16
+    e16 = step._expand_wire({k2: jnp.asarray(v) for k2, v in w16.items()})
+    e32 = step._expand_wire({k2: jnp.asarray(v) for k2, v in w32.items()})
+    for key in ("hot_keys", "hot_mask", "hot_vals"):
+        np.testing.assert_array_equal(
+            np.asarray(e16[key]), np.asarray(e32[key]), err_msg=key
+        )
+
+
+def test_hot_u16_disabled_above_sentinel_range():
+    """hot_size_log2 = 16 would let a real id collide with the 0xFFFF
+    sentinel, so the step must fall back to the int32 hot plane."""
+    from xflow_tpu.models import make_model
+    from xflow_tpu.optim import make_optimizer
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.parallel.step import TrainStep
+
+    cfg = Config(
+        model="lr", batch_size=32, table_size_log2=18, max_nnz=8,
+        max_fields=8, num_devices=1, hot_size_log2=16, hot_nnz=4,
+    )
+    step = TrainStep(
+        make_model(cfg), make_optimizer(cfg), cfg, make_mesh(1)
+    )
+    assert not step._hot_u16
