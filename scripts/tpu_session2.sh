@@ -79,11 +79,11 @@ python scripts/time_to_auc.py --model lr --table-size-log2 28 \
 tail -2 "$OUT/ttauc_t28.out"
 
 log "6/6 wall-to-AUC for the D>1 families, sparse inner (fm, mvm)"
-python scripts/time_to_auc.py --model fm --sequential-inner sparse \
+python scripts/time_to_auc.py --model fm --sequential-inner sparse --max-epochs 10 \
     --out docs/artifacts/time_to_auc_fm_sparse.json \
     >"$OUT/ttauc_fm.out" 2>"$OUT/ttauc_fm.err"
 tail -1 "$OUT/ttauc_fm.out"
-python scripts/time_to_auc.py --model mvm --sequential-inner sparse \
+python scripts/time_to_auc.py --model mvm --sequential-inner sparse --max-epochs 10 \
     --out docs/artifacts/time_to_auc_mvm_sparse.json \
     >"$OUT/ttauc_mvm.out" 2>"$OUT/ttauc_mvm.err"
 tail -1 "$OUT/ttauc_mvm.out"
