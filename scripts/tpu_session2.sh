@@ -32,29 +32,7 @@ python scripts/time_to_auc.py --model lr --sequential-inner sparse \
     >"$OUT/ttauc_sparse_flag.out" 2>"$OUT/ttauc_sparse_flag.err"
 tail -2 "$OUT/ttauc_sparse_flag.out"
 
-log "2/6 lr flagship neighbors (resolve the interpolated flagship row)"
-python scripts/bench_models.py --model lr --batch-log2 17 \
-    --hot-log2 12 --cold-nnz 12 \
-    >>"$OUT/lr_neighbors.out" 2>>"$OUT/lr_neighbors.err"
-python scripts/bench_models.py --model lr --batch-log2 17 \
-    --hot-log2 12 --hot-dtype bfloat16 \
-    >>"$OUT/lr_neighbors.out" 2>>"$OUT/lr_neighbors.err"
-tail -2 "$OUT/lr_neighbors.out"
-
-log "3/6 D>1 hot-head scaling: fm/mvm/wide_deep hot {15,16} + bf16"
-for m in fm mvm wide_deep; do
-  for h in 15 16; do
-    python scripts/bench_models.py --model "$m" --batch-log2 17 \
-        --hot-log2 "$h" \
-        >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
-  done
-  python scripts/bench_models.py --model "$m" --batch-log2 17 \
-      --hot-log2 14 --hot-dtype bfloat16 \
-      >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
-done
-tail -9 "$OUT/models_sweep.out"
-
-log "4/6 reference-shaped e2e on TPU: CLI train over packed cache + ckpt + resume"
+log "2/6 reference-shaped e2e on TPU: CLI train over the binary cache + ckpt + resume"
 rm -rf /tmp/ck_tpu /tmp/pred_tpu.txt
 python -m xflow_tpu.train --model lr \
     --train /tmp/xflow_conv/bin.train --test /tmp/xflow_conv/bin.test \
@@ -71,12 +49,34 @@ python -m xflow_tpu.train --model lr \
     >"$OUT/e2e_resume.out" 2>"$OUT/e2e_resume.err"
 tail -3 "$OUT/e2e_resume.out"
 
-log "5/6 time_to_auc t28 sparse inner (north-star table)"
+log "3/6 lr flagship neighbors (resolve the interpolated flagship row)"
+python scripts/bench_models.py --model lr --batch-log2 17 \
+    --hot-log2 12 --cold-nnz 12 \
+    >>"$OUT/lr_neighbors.out" 2>>"$OUT/lr_neighbors.err"
+python scripts/bench_models.py --model lr --batch-log2 17 \
+    --hot-log2 12 --hot-dtype bfloat16 \
+    >>"$OUT/lr_neighbors.out" 2>>"$OUT/lr_neighbors.err"
+tail -2 "$OUT/lr_neighbors.out"
+
+log "4/6 time_to_auc t28 sparse inner (north-star table)"
 python scripts/time_to_auc.py --model lr --table-size-log2 28 \
     --sequential-inner sparse --max-epochs 2 --target-auc 0.99 \
     --out docs/artifacts/time_to_auc_lr_t28.json \
     >"$OUT/ttauc_t28.out" 2>"$OUT/ttauc_t28.err"
 tail -2 "$OUT/ttauc_t28.out"
+
+log "5/6 D>1 hot-head scaling: fm/mvm/wide_deep hot {15,16} + bf16"
+for m in fm mvm wide_deep; do
+  for h in 15 16; do
+    python scripts/bench_models.py --model "$m" --batch-log2 17 \
+        --hot-log2 "$h" \
+        >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+  done
+  python scripts/bench_models.py --model "$m" --batch-log2 17 \
+      --hot-log2 14 --hot-dtype bfloat16 \
+      >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+done
+tail -9 "$OUT/models_sweep.out"
 
 log "6/6 wall-to-AUC for the D>1 families, sparse inner (fm, mvm)"
 python scripts/time_to_auc.py --model fm --sequential-inner sparse --max-epochs 10 \
