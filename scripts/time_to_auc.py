@@ -118,8 +118,9 @@ def main():
     p.add_argument("--hot-nnz", type=int, default=32)
     p.add_argument(
         "--sequential-inner", default="dense",
-        choices=["dense", "sparse"],
-        help="sparse = touched-rows-only per slice (T=2^28 scale)",
+        choices=["dense", "sparse", "hot"],
+        help="sparse = touched-rows-only per slice (T=2^28 scale); "
+        "hot = hot-fine/cold-coarse (needs --hot-size-log2)",
     )
     p.add_argument("--examples", type=int, default=0,
                    help="cap train examples (0 = all; smoke tests)")

@@ -164,7 +164,22 @@ class Config:
     #   touched-rows, hot section a dense [H, D] update with overflow
     #   spill folded in exactly once (step.py::_sparse_update).
     #   Equivalence: tests/test_sequential.py.
-    sequential_inner: str = "dense"  # {"dense", "sparse"}
+    # "hot" — hot-FINE / cold-COARSE: per slice the optimizer updates
+    #   ONLY the dense hot head (on-chip, MXU one-hot traffic — no
+    #   per-slice DMA at all); cold-section gradients accumulate
+    #   per-occurrence and the cold tail takes ONE batched scatter +
+    #   table pass per dispatch window.  Cold rows are read once at
+    #   window start (one efficient batched gather) and are stale for
+    #   at most one dispatch window — the async-parameter-server
+    #   semantics of the reference itself, whose workers compute on
+    #   weights pulled a minibatch ago (lr_worker.cc:95-143, ps-lite
+    #   async Push/Pull), applied here only to the zipf TAIL while the
+    #   head (most of the occurrence mass) updates at full B_eff
+    #   granularity.  Requires hot_size_log2 > 0.  The per-slice cost
+    #   is table-size-independent AND free of scatter/gather DMA
+    #   latency — the form that turns sequential mode's convergence
+    #   into device-rate wall-clock (docs/PERF.md "Sequential mode").
+    sequential_inner: str = "dense"  # {"dense", "sparse", "hot"}
 
     # Gradient-accumulation slices per train step (1 = off).  The batch
     # is split into `microbatch` equal slices scanned sequentially;
@@ -256,9 +271,15 @@ class Config:
                     f"microbatch {self.microbatch} must divide "
                     f"batch_size {self.batch_size}"
                 )
-        if self.sequential_inner not in ("dense", "sparse"):
+        if self.sequential_inner not in ("dense", "sparse", "hot"):
             raise ValueError(
                 f"unknown sequential_inner {self.sequential_inner!r}"
+            )
+        if self.sequential_inner == "hot" and not self.hot_size_log2:
+            raise ValueError(
+                "sequential_inner='hot' needs a hot table "
+                "(hot_size_log2 > 0) — the per-slice update IS the "
+                "hot head"
             )
         if self.cold_consolidate and self.update_mode not in (
             "dense",

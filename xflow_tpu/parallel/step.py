@@ -387,6 +387,19 @@ class TrainStep:
         reproduces the whole-batch mean-gradient semantics exactly
         (lr_worker.cc:116-118)."""
         rows = self._gather_model_rows(tables, batch)
+        return self._grads_from_rows(rows, dense, batch, num_real)
+
+    def _grads_from_rows(
+        self,
+        rows: dict,
+        dense: dict,
+        batch: BatchArrays,
+        num_real: jax.Array,
+    ):
+        """_forward_grads with the row gather already done — the hot
+        sequential inner supplies rows from the carried hot head plus
+        a window-start cold pre-gather instead of a live table
+        gather."""
         mbatch = self._model_view(batch)
         if getattr(self.model, "autodiff", False):
             # Autodiff path (FFM, wide&deep — no reference gradient
@@ -669,6 +682,8 @@ class TrainStep:
         rows only — table-size-independent, the form 2^28-scale tables
         require.  See docs/PERF.md 'Sequential mode'."""
         cfg = self.cfg
+        if cfg.sequential_inner == "hot":
+            return self._train_sequential_hot(state, batch)
         tables = state["tables"]
         dense = state["dense"]
         s = cfg.microbatch
@@ -707,6 +722,159 @@ class TrainStep:
         (new_tables, new_dense, nll_sum, cnt), _ = jax.lax.scan(
             body, (tables, dense, zero, zero), xs
         )
+        ll = nll_sum / jnp.maximum(cnt, 1.0)
+        return {
+            "tables": new_tables,
+            "dense": new_dense,
+            "step": state["step"] + 1,
+        }, {"logloss": ll, "count": cnt}
+
+    def _train_sequential_hot(
+        self, state: State, batch: BatchArrays
+    ) -> tuple[State, dict[str, jax.Array]]:
+        """sequential_inner='hot': hot-FINE / cold-COARSE.
+
+        The dense and sparse inners both pay per-slice work that fights
+        the hardware — a full [T, D] HBM stream (dense) or a
+        latency-bound consolidate+gather+scatter of ~85-107 ns/slice
+        DMA descriptors (sparse; docs/PERF.md "Multi-lane
+        scatter-add").  Measured on v5e they cost 36.8 s and ~50 s per
+        10 M-example epoch respectively at the flagship geometry.  This
+        inner removes BOTH costs from the scan body:
+
+        * the frequency-hot head (table rows [0, H), ~71% of occurrence
+          mass at the lr flagship remap — docs/PERF.md) rides the scan
+          carry and takes a FULL-granularity optimizer step per
+          B_eff-slice, all in MXU one-hot matmuls + [H, D] elementwise
+          work — no DMA;
+        * cold (tail) rows are pre-gathered ONCE per dispatch window in
+          a single batched DMA gather (the 3 M ex/s throughput path's
+          access pattern), their per-occurrence gradients are stacked
+          as scan outputs, and the window closes with ONE batched
+          scatter-add + ONE full-table optimizer pass — exactly the
+          dense-mode tail, amortized over `microbatch` slices.
+
+        Semantics vs true sequential: cold values are stale by at most
+        one dispatch window, and a cold key occurring k>1 times in the
+        window sees one summed-gradient update instead of k — the
+        async-parameter-server behavior of the reference itself, whose
+        workers compute on weights pulled a minibatch ago and push
+        asynchronously (lr_worker.cc:95-143), here confined to the
+        zipf TAIL.  Hot rows — where intra-window repetition actually
+        concentrates — get bit-exact B_eff-granular treatment.
+        Overflow spill (hot-eligible keys in the cold plane,
+        io/batch.py split_hot) is handled exactly once: its grads ride
+        the window-end pass, which runs AFTER the evolved head is
+        written back, so no update is lost or doubled.  Quality:
+        docs/CONVERGENCE.md overlay; wall-clock: docs/PERF.md."""
+        cfg = self.cfg
+        if "hot_keys" not in batch:
+            raise ValueError(
+                "sequential_inner='hot' needs hot batch planes — was "
+                "the loader built with the hot table geometry?"
+            )
+        from xflow_tpu.ops.hot import hot_gather, hot_scatter
+
+        tables = state["tables"]
+        dense = state["dense"]
+        s = cfg.microbatch
+        h = cfg.hot_size
+        # Window-start cold values: ONE batched gather per table,
+        # hoisted out of the scan.  Padding slots read row 0 and are
+        # masked out of every reduction downstream (same convention as
+        # _gather_model_rows).
+        cold_rows = {
+            name: t["param"][batch["keys"]] for name, t in tables.items()
+        }
+        heads0 = {
+            name: {k: arr[:h] for k, arr in t.items()}
+            for name, t in tables.items()
+        }
+        xs = (
+            _interleaved_slices(batch, s),
+            _interleaved_slices(cold_rows, s),
+        )
+
+        def body(carry, slice_in):
+            heads, dense_c, nll_c, cnt_c = carry
+            bslice, cold_slice = slice_in
+            w_sum = jnp.sum(bslice["weights"])
+            num_real = jnp.maximum(w_sum, 1.0)
+            b, kh = bslice["hot_keys"].shape
+            rows = {}
+            for name, head in heads.items():
+                d = head["param"].shape[-1]
+                hot = hot_gather(
+                    head["param"],
+                    bslice["hot_keys"].reshape(-1),
+                    dtype=self._hot_dtype,
+                ).reshape(b, kh, d)
+                rows[name] = jnp.concatenate(
+                    [hot, cold_slice[name]], axis=1
+                )
+            pctr_s, occ_s, gd = self._grads_from_rows(
+                rows, dense_c, bslice, num_real
+            )
+            hot_keys_eff = self._hot_keys_eff(bslice)
+            new_heads = {}
+            cold_occ = {}
+            for name, head in heads.items():
+                d = head["param"].shape[-1]
+                g = occ_s[name]
+                hot_g = g[:, :kh].reshape(-1, d)
+                cold_occ[name] = g[:, kh:]
+                ghot = hot_scatter(
+                    hot_keys_eff, hot_g, h, dtype=self._hot_dtype
+                )
+                new_heads[name] = self.optimizer.update_rows(head, ghot)
+            new_dense = self._apply_dense_sgd(dense_c, gd)
+            nll_c = nll_c + logloss_sum(
+                bslice["labels"], pctr_s, bslice["weights"]
+            )
+            return (
+                (new_heads, new_dense, nll_c, cnt_c + w_sum),
+                cold_occ,
+            )
+
+        zero = jnp.zeros((), jnp.float32)
+        (new_heads, new_dense, nll_sum, cnt), cold_occ = jax.lax.scan(
+            body, (heads0, dense, zero, zero), xs
+        )
+        # Close the window: write the evolved head back, then apply the
+        # accumulated cold-tail grads in one dense pass (g=0 rows are
+        # idempotent under FTRL/SGD — optim docstrings).  Spill grads
+        # (cold-plane keys < H) land on the written-back head rows
+        # here, exactly once.
+        sentinel = jnp.int32(cfg.table_size)
+        keys_eff = jnp.where(
+            batch["mask"] > 0, batch["keys"], sentinel
+        ).reshape(-1)
+        plan = (
+            consolidate_plan(keys_eff, cfg.table_size)
+            if cfg.cold_consolidate
+            else None
+        )
+        new_tables = {}
+        for name, table in tables.items():
+            d = table["param"].shape[-1]
+            merged = {
+                k: jax.lax.dynamic_update_slice_in_dim(
+                    table[k], new_heads[name][k], 0, axis=0
+                )
+                for k in table
+            }
+            # un-interleave the stacked [s, B/s, Kc, D] slice outputs
+            # back to batch order (example i lives at slice i%s,
+            # position i//s — _interleaved_slices)
+            occ = cold_occ[name].swapaxes(0, 1).reshape(-1, d)
+            zeros = jnp.zeros_like(table["param"])
+            if plan is not None:
+                order, seg, ukeys = plan
+                gsum = consolidate_apply(occ, order, seg)
+                gbuf = zeros.at[ukeys].add(gsum, mode="drop")
+            else:
+                gbuf = zeros.at[keys_eff].add(occ, mode="drop")
+            new_tables[name] = self.optimizer.update_rows(merged, gbuf)
         ll = nll_sum / jnp.maximum(cnt, 1.0)
         return {
             "tables": new_tables,

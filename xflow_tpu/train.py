@@ -88,10 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--sequential-inner", dest="sequential_inner",
-        choices=["dense", "sparse"],
+        choices=["dense", "sparse", "hot"],
         help="per-slice update strategy under --update-mode sequential: "
         "dense = full-table pass (T<=2^24); sparse = touched-rows only "
-        "(required at 2^28-scale tables)",
+        "(required at 2^28-scale tables); hot = hot-fine/cold-coarse "
+        "(per-slice updates only the hot head, cold tail batched per "
+        "dispatch window — needs --hot-size-log2)",
     )
     p.add_argument(
         "--cold-consolidate", action="store_true", default=None,
