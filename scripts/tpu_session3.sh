@@ -1,0 +1,53 @@
+#!/bin/bash
+# Round-5 TPU measurement queue, part 3 — the hot-fine/cold-coarse
+# sequential inner (sequential_inner='hot', step.py::_train_sequential_hot),
+# built after part 2's first results showed BOTH existing inners miss
+# the >=5x north star on wall-clock:
+#   dense inner  36.8 s/epoch -> 232.8 s to AUC 0.7401 (3.4x total)
+#   sparse inner ~50 s/epoch  -> 395.9 s               (2.33x total)
+# The hot inner removes per-slice DMA and full-table streams from the
+# scan entirely; the TPU run is ALSO the quality experiment — crossing
+# 0.7401 proves the cold-coarsening/staleness cost is absorbed.
+# Run when the tunnel is healthy: bash scripts/tpu_session3.sh [outdir]
+# NO timeouts around TPU-bound processes (verify skill).
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_r5c}"
+mkdir -p "$OUT"
+log() { echo "[$(date -u +%H:%M:%S)] $*"; }
+
+log "1/4 HEADLINE: time_to_auc lr, hot inner, flagship geometry"
+python scripts/time_to_auc.py --model lr --sequential-inner hot \
+    --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
+    --out docs/artifacts/time_to_auc_lr_hot_flagship.json \
+    >"$OUT/ttauc_hot_flag.out" 2>"$OUT/ttauc_hot_flag.err"
+tail -2 "$OUT/ttauc_hot_flag.out"
+
+log "2/4 hot inner, bigger head (2^14x32): more mass fine-grained"
+python scripts/time_to_auc.py --model lr --sequential-inner hot \
+    --hot-size-log2 14 --hot-nnz 32 --max-nnz 16 \
+    --out docs/artifacts/time_to_auc_lr_hot14.json \
+    >"$OUT/ttauc_hot14.out" 2>"$OUT/ttauc_hot14.err"
+tail -2 "$OUT/ttauc_hot14.out"
+
+log "3/4 north-star table: hot inner at T=2^28 (2 epochs, rate probe)"
+python scripts/time_to_auc.py --model lr --table-size-log2 28 \
+    --sequential-inner hot --hot-size-log2 14 --hot-nnz 32 --max-nnz 16 \
+    --max-epochs 2 --target-auc 0.99 \
+    --out docs/artifacts/time_to_auc_lr_hot_t28.json \
+    >"$OUT/ttauc_hot_t28.out" 2>"$OUT/ttauc_hot_t28.err"
+tail -2 "$OUT/ttauc_hot_t28.out"
+
+log "4/4 D>1 families on the hot inner: fm, mvm wall-to-AUC"
+python scripts/time_to_auc.py --model fm --sequential-inner hot \
+    --hot-size-log2 14 --hot-nnz 32 --max-nnz 16 --max-epochs 10 \
+    --out docs/artifacts/time_to_auc_fm_hot.json \
+    >"$OUT/ttauc_fm_hot.out" 2>"$OUT/ttauc_fm_hot.err"
+tail -1 "$OUT/ttauc_fm_hot.out"
+python scripts/time_to_auc.py --model mvm --sequential-inner hot \
+    --hot-size-log2 14 --hot-nnz 32 --max-nnz 16 --max-epochs 10 \
+    --out docs/artifacts/time_to_auc_mvm_hot.json \
+    >"$OUT/ttauc_mvm_hot.out" 2>"$OUT/ttauc_mvm_hot.err"
+tail -1 "$OUT/ttauc_mvm_hot.out"
+
+log "queue complete — results in $OUT and docs/artifacts/"

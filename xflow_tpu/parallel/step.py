@@ -431,6 +431,20 @@ class TrainStep:
         }
         return pctr, occ_grads, None
 
+    def _cold_accumulate(
+        self, gbuf: jax.Array, keys_eff: jax.Array, occ: jax.Array, plan
+    ) -> jax.Array:
+        """Accumulate per-occurrence cold grads [M, D] into a [T, D]
+        buffer under the ONE sentinel/drop convention (pad keys carry
+        index T, dropped by mode='drop'), via the consolidate plan
+        when one is supplied.  Shared by _scatter_grads and the hot
+        inner's window-end pass so the two cannot drift."""
+        if plan is not None:
+            order, seg, ukeys = plan
+            gsum = consolidate_apply(occ, order, seg)
+            return gbuf.at[ukeys].add(gsum, mode="drop")
+        return gbuf.at[keys_eff].add(occ, mode="drop")
+
     def _scatter_grads(
         self,
         tables: dict,
@@ -465,14 +479,9 @@ class TrainStep:
                 # buffer; cold grads keep the DMA scatter path.
                 hot_g = occ[:, :kh].reshape(-1, d)
                 occ = occ[:, kh:]
-            if plan is not None:
-                order, seg, ukeys = plan
-                gsum = consolidate_apply(occ.reshape(-1, d), order, seg)
-                gbuf = gbufs[name].at[ukeys].add(gsum, mode="drop")
-            else:
-                gbuf = gbufs[name].at[keys_eff].add(
-                    occ.reshape(-1, d), mode="drop"
-                )
+            gbuf = self._cold_accumulate(
+                gbufs[name], keys_eff, occ.reshape(-1, d), plan
+            )
             if kh:
                 ghot = hot_scatter(
                     hot_keys_eff, hot_g, cfg.hot_size,
@@ -867,13 +876,9 @@ class TrainStep:
             # back to batch order (example i lives at slice i%s,
             # position i//s — _interleaved_slices)
             occ = cold_occ[name].swapaxes(0, 1).reshape(-1, d)
-            zeros = jnp.zeros_like(table["param"])
-            if plan is not None:
-                order, seg, ukeys = plan
-                gsum = consolidate_apply(occ, order, seg)
-                gbuf = zeros.at[ukeys].add(gsum, mode="drop")
-            else:
-                gbuf = zeros.at[keys_eff].add(occ, mode="drop")
+            gbuf = self._cold_accumulate(
+                jnp.zeros_like(table["param"]), keys_eff, occ, plan
+            )
             new_tables[name] = self.optimizer.update_rows(merged, gbuf)
         ll = nll_sum / jnp.maximum(cnt, 1.0)
         return {
