@@ -17,14 +17,14 @@ mkdir -p "$OUT"
 log() { echo "[$(date -u +%H:%M:%S)] $*"; }
 
 log "1/4 HEADLINE: time_to_auc lr, hot inner, flagship geometry"
-python scripts/time_to_auc.py --model lr --sequential-inner hot \
+python scripts/time_to_auc.py --model lr --sequential-inner hot --max-epochs 9 \
     --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
     --out docs/artifacts/time_to_auc_lr_hot_flagship.json \
     >"$OUT/ttauc_hot_flag.out" 2>"$OUT/ttauc_hot_flag.err"
 tail -2 "$OUT/ttauc_hot_flag.out"
 
 log "2/4 hot inner, bigger head (2^14x32): more mass fine-grained"
-python scripts/time_to_auc.py --model lr --sequential-inner hot \
+python scripts/time_to_auc.py --model lr --sequential-inner hot --max-epochs 9 \
     --hot-size-log2 14 --hot-nnz 32 --max-nnz 16 \
     --out docs/artifacts/time_to_auc_lr_hot14.json \
     >"$OUT/ttauc_hot14.out" 2>"$OUT/ttauc_hot14.err"

@@ -1,0 +1,53 @@
+#!/bin/bash
+# Round-5 TPU measurement queue, part 4 — what remains of part 2 after
+# the 04:06 UTC 2026-07-31 tunnel drop left tpu_session2.sh hung inside
+# step 1b (hybrid sparse+hot).  Parts 4 of part 2 (t28) and 6 (fm/mvm
+# wall-to-AUC) are superseded by tpu_session3.sh's hot-inner runs; this
+# script holds the rest.  Run AFTER tpu_session3.sh.
+# NO timeouts around TPU-bound processes (verify skill).
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_r5d}"
+mkdir -p "$OUT"
+log() { echo "[$(date -u +%H:%M:%S)] $*"; }
+
+log "1/3 reference-shaped e2e on TPU: CLI train over the binary cache + ckpt + resume"
+rm -rf /tmp/ck_tpu /tmp/pred_tpu.txt
+python -m xflow_tpu.train --model lr \
+    --train /tmp/xflow_conv/bin.train --test /tmp/xflow_conv/bin.test \
+    --epochs 2 --batch-size 131072 --table-size-log2 24 --max-nnz 40 \
+    --hot-size-log2 12 --hot-nnz 32 --num-devices 1 \
+    --checkpoint-dir /tmp/ck_tpu --metrics-out "$OUT/e2e_train_metrics.jsonl" \
+    >"$OUT/e2e_train.out" 2>"$OUT/e2e_train.err"
+tail -3 "$OUT/e2e_train.out"
+python -m xflow_tpu.train --model lr \
+    --train /tmp/xflow_conv/bin.train --test /tmp/xflow_conv/bin.test \
+    --epochs 3 --batch-size 131072 --table-size-log2 24 --max-nnz 40 \
+    --hot-size-log2 12 --hot-nnz 32 --num-devices 1 \
+    --checkpoint-dir /tmp/ck_tpu --resume \
+    >"$OUT/e2e_resume.out" 2>"$OUT/e2e_resume.err"
+tail -3 "$OUT/e2e_resume.out"
+
+log "2/3 lr flagship neighbors (cold-nnz 12, bf16 hot)"
+python scripts/bench_models.py --model lr --batch-log2 17 \
+    --hot-log2 12 --cold-nnz 12 \
+    >>"$OUT/lr_neighbors.out" 2>>"$OUT/lr_neighbors.err"
+python scripts/bench_models.py --model lr --batch-log2 17 \
+    --hot-log2 12 --hot-dtype bfloat16 \
+    >>"$OUT/lr_neighbors.out" 2>>"$OUT/lr_neighbors.err"
+tail -2 "$OUT/lr_neighbors.out"
+
+log "3/3 D>1 hot-head scaling: fm/mvm/wide_deep hot {15,16} + bf16"
+for m in fm mvm wide_deep; do
+  for h in 15 16; do
+    python scripts/bench_models.py --model "$m" --batch-log2 17 \
+        --hot-log2 "$h" \
+        >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+  done
+  python scripts/bench_models.py --model "$m" --batch-log2 17 \
+      --hot-log2 14 --hot-dtype bfloat16 \
+      >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+done
+tail -9 "$OUT/models_sweep.out"
+
+log "queue complete — results in $OUT"

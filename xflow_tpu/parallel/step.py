@@ -431,6 +431,16 @@ class TrainStep:
         }
         return pctr, occ_grads, None
 
+    def _cold_keys_eff(self, batch: BatchArrays) -> jax.Array:
+        """Sentinel-coded flat cold keys: masked slots → T, which the
+        drop-mode scatters and consolidate_plan treat as out-of-range.
+        The ONE definition of the cold sentinel convention (counterpart
+        of _hot_keys_eff), shared by _scatter_grads, _sparse_update and
+        the hot inner's window-end pass."""
+        return jnp.where(
+            batch["mask"] > 0, batch["keys"], jnp.int32(self.cfg.table_size)
+        ).reshape(-1)
+
     def _cold_accumulate(
         self, gbuf: jax.Array, keys_eff: jax.Array, occ: jax.Array, plan
     ) -> jax.Array:
@@ -457,10 +467,7 @@ class TrainStep:
         one-hot MXU matmuls for the hot section (ops/hot.py)."""
         cfg = self.cfg
         kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
-        sentinel = jnp.int32(cfg.table_size)
-        keys_eff = jnp.where(
-            batch["mask"] > 0, batch["keys"], sentinel
-        ).reshape(-1)
+        keys_eff = self._cold_keys_eff(batch)
         plan = None
         if cfg.cold_consolidate:
             # one shared argsort over the cold keys; every table's
@@ -614,9 +621,7 @@ class TrainStep:
         cfg = self.cfg
         kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
         sentinel = jnp.int32(cfg.table_size)
-        keys_eff = jnp.where(
-            batch["mask"] > 0, batch["keys"], sentinel
-        ).reshape(-1)
+        keys_eff = self._cold_keys_eff(batch)
         # one shared argsort; every table's gradients ride the same
         # permutation/segments (same sharing as _scatter_grads)
         order, seg, ukeys = consolidate_plan(keys_eff, cfg.table_size)
@@ -854,10 +859,7 @@ class TrainStep:
         # idempotent under FTRL/SGD — optim docstrings).  Spill grads
         # (cold-plane keys < H) land on the written-back head rows
         # here, exactly once.
-        sentinel = jnp.int32(cfg.table_size)
-        keys_eff = jnp.where(
-            batch["mask"] > 0, batch["keys"], sentinel
-        ).reshape(-1)
+        keys_eff = self._cold_keys_eff(batch)
         plan = (
             consolidate_plan(keys_eff, cfg.table_size)
             if cfg.cold_consolidate
