@@ -30,6 +30,13 @@ python scripts/time_to_auc.py --model lr --sequential-inner hot --max-epochs 9 \
     >"$OUT/ttauc_hot14.out" 2>"$OUT/ttauc_hot14.err"
 tail -2 "$OUT/ttauc_hot14.out"
 
+log "2b/4 hot inner, half window (B=65536): halves cold staleness/coarsening"
+python scripts/time_to_auc.py --model lr --sequential-inner hot --max-epochs 9 \
+    --batch-size 65536 --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
+    --out docs/artifacts/time_to_auc_lr_hot_b64k.json \
+    >"$OUT/ttauc_hot_b64k.out" 2>"$OUT/ttauc_hot_b64k.err"
+tail -2 "$OUT/ttauc_hot_b64k.out"
+
 log "3/4 north-star table: hot inner at T=2^28 (2 epochs, rate probe)"
 python scripts/time_to_auc.py --model lr --table-size-log2 28 \
     --sequential-inner hot --hot-size-log2 14 --hot-nnz 32 --max-nnz 16 \

@@ -133,8 +133,10 @@ def _cfg(ds, **kw):
     return Config(**base)
 
 
-@pytest.mark.parametrize("model", ["lr", "fm"])
+@pytest.mark.parametrize("model", ["lr", "fm", "ffm"])
 def test_hot_training_matches_dma_training(zipfy_dataset, model, tmp_path):
+    # ffm exercises the mixed per-table hot route (TableSpec.hot):
+    # w rides the MXU one-hot path, v keeps DMA for hot occurrences
     cold = Trainer(_cfg(zipfy_dataset, model=model))
     cold.train()
     cold_out = tmp_path / "cold_pred.txt"

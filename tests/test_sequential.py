@@ -204,7 +204,7 @@ def test_sequential_sparse_inner_equals_dense_inner(model):
         )
 
 
-@pytest.mark.parametrize("model", ["lr", "fm"])
+@pytest.mark.parametrize("model", ["lr", "fm", "ffm"])
 def test_sequential_sparse_inner_hybrid_hot(model):
     """sparse inner + hot table (the hybrid, step.py::_sparse_update):
     cold keys keep the touched-rows path, the hot section gets a dense
@@ -445,6 +445,22 @@ def test_sequential_hot_inner_spill_trains():
 def test_hot_inner_requires_hot_table():
     with pytest.raises(ValueError, match="hot"):
         base_cfg("lr", update_mode="sequential", sequential_inner="hot")
+
+
+def test_hot_inner_rejects_mxu_opted_out_tables():
+    """ffm opts its wide v table out of the MXU hot path
+    (TableSpec.hot=False) — the hot inner carries every table's head
+    in the scan, so TrainStep must refuse the combination up front."""
+    cfg = base_cfg(
+        "ffm",
+        update_mode="sequential",
+        microbatch=M,
+        sequential_inner="hot",
+        hot_size_log2=8,
+        hot_nnz=4,
+    )
+    with pytest.raises(ValueError, match="opts table"):
+        build("ffm", cfg)
 
 
 @pytest.mark.parametrize(

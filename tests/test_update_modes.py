@@ -79,6 +79,9 @@ def test_dense_equals_sparse_sgd(toy_dataset):
         ("wide_deep", {"emb_dim": 4, "hidden_dim": 8}),
         # hot table + microbatch compose: hot sections split per slice
         ("lr", {"hot_size_log2": 8, "hot_nnz": 8}),
+        # mixed per-table hot (TableSpec.hot): ffm's w rides the MXU,
+        # v keeps plain DMA for its hot-plane occurrences
+        ("ffm", {"ffm_v_dim": 2, "hot_size_log2": 8, "hot_nnz": 8}),
     ],
 )
 def test_microbatch_equals_full_batch(toy_dataset, model, kw):

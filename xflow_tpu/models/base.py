@@ -35,6 +35,17 @@ class TableSpec:
     name: str
     dim: int  # row width (1 for w; v_dim for latent factors)
     init: Callable[[jax.Array, tuple[int, int]], jax.Array]  # (rng, shape) -> array
+    # Whether this table's hot-plane occurrences ride the two-level
+    # one-hot MXU path (ops/hot.py).  The MXU route moves
+    # M*(h1 + h2*dim) one-hot elements per M occurrences — a win for
+    # narrow rows, but for very wide rows (FFM's v: max_fields*v_dim
+    # ≈ 156 lanes) the h2*dim term makes it slower than the ~100 ns
+    # DMA descriptor it replaces.  hot=False keeps THIS table's hot
+    # occurrences on plain gather/scatter while other tables (and the
+    # batch steering/remap) still use the hot machinery — e.g. FFM
+    # takes the MXU win on its scalar w and leaves v on DMA, halving
+    # its per-occurrence descriptor count.
+    hot: bool = True
 
 
 class Model(Protocol):

@@ -55,6 +55,11 @@ class FFMModel(AutodiffModel):
                 lambda rng, shape: (
                     jax.random.normal(rng, shape, jnp.float32) * self.v_init_scale
                 ),
+                # v rows are max_fields*v_dim ≈ 156 lanes wide: the
+                # one-hot h2*dim traffic exceeds the DMA cost it
+                # replaces, so only w rides the MXU hot path
+                # (TableSpec.hot rationale)
+                hot=False,
             ),
         ]
 

@@ -229,6 +229,18 @@ class TrainStep:
         self._hot_u16 = bool(
             cfg.hot_size_log2 and cfg.hot_size_log2 <= 15
         )
+        # Per-table MXU hot opt-out (TableSpec.hot): opted-out tables
+        # keep their hot-plane occurrences on plain DMA gather/scatter.
+        self._mxu_hot = {spec.name: spec.hot for spec in model.tables()}
+        if cfg.sequential_inner == "hot" and not all(
+            self._mxu_hot.values()
+        ):
+            opted_out = [n for n, v in self._mxu_hot.items() if not v]
+            raise ValueError(
+                "sequential_inner='hot' carries every table's head in "
+                f"the scan; model {model.name!r} opts table(s) "
+                f"{opted_out} out of the MXU hot path (TableSpec.hot)"
+            )
         compact_ok = cfg.hash_mode and not (
             self._ship_slots and cfg.max_fields > 255
         )
@@ -334,11 +346,17 @@ class TrainStep:
         out = {}
         for name, t in tables.items():
             d = t["param"].shape[-1]
-            hot = hot_gather(
-                t["param"][:h],
-                batch["hot_keys"].reshape(-1),
-                dtype=self._hot_dtype,
-            ).reshape(b, kh, d)
+            if self._mxu_hot[name]:
+                hot = hot_gather(
+                    t["param"][:h],
+                    batch["hot_keys"].reshape(-1),
+                    dtype=self._hot_dtype,
+                ).reshape(b, kh, d)
+            else:
+                # opted-out table (TableSpec.hot=False): hot rows are
+                # ordinary table rows [0, H) — plain gather; padding
+                # reads row 0, masked downstream like the cold plane
+                hot = t["param"][batch["hot_keys"]]
             out[name] = jnp.concatenate([hot, cold[name]], axis=1)
         return out
 
@@ -431,6 +449,17 @@ class TrainStep:
         }
         return pctr, occ_grads, None
 
+    def _hot_keys_eff_dma(self, batch: BatchArrays) -> jax.Array:
+        """Hot-plane keys sentinel-coded for a DROP-mode scatter into
+        the FULL [T, D] table (opted-out tables, TableSpec.hot=False):
+        masked slots → T, out of range.  _hot_keys_eff's sentinel H is
+        a real table row and only works for [H, D] buffers."""
+        return jnp.where(
+            batch["hot_mask"] > 0,
+            batch["hot_keys"],
+            jnp.int32(self.cfg.table_size),
+        ).reshape(-1)
+
     def _cold_keys_eff(self, batch: BatchArrays) -> jax.Array:
         """Sentinel-coded flat cold keys: masked slots → T, which the
         drop-mode scatters and consolidate_plan treat as out-of-range.
@@ -490,11 +519,16 @@ class TrainStep:
                 gbufs[name], keys_eff, occ.reshape(-1, d), plan
             )
             if kh:
-                ghot = hot_scatter(
-                    hot_keys_eff, hot_g, cfg.hot_size,
-                    dtype=self._hot_dtype,
-                )
-                gbuf = gbuf.at[: cfg.hot_size].add(ghot)
+                if self._mxu_hot[name]:
+                    ghot = hot_scatter(
+                        hot_keys_eff, hot_g, cfg.hot_size,
+                        dtype=self._hot_dtype,
+                    )
+                    gbuf = gbuf.at[: cfg.hot_size].add(ghot)
+                else:
+                    gbuf = gbuf.at[self._hot_keys_eff_dma(batch)].add(
+                        hot_g, mode="drop"
+                    )
             out[name] = gbuf
         return out
 
@@ -617,7 +651,13 @@ class TrainStep:
         < H are folded into the hot gradient buffer and masked out of
         the sparse scatter — every row sees ONE summed-gradient
         update, matching the dense path's gbuf semantics bit-for-bit
-        in structure."""
+        in structure.
+
+        Tables opted OUT of the MXU path (TableSpec.hot=False, e.g.
+        FFM's wide v) instead fold their hot-plane occurrences into a
+        SECOND consolidate over cold+hot keys and take the plain
+        touched-rows update for everything — same exactly-once
+        guarantee, no [H, D] buffer."""
         cfg = self.cfg
         kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
         sentinel = jnp.int32(cfg.table_size)
@@ -625,6 +665,7 @@ class TrainStep:
         # one shared argsort; every table's gradients ride the same
         # permutation/segments (same sharing as _scatter_grads)
         order, seg, ukeys = consolidate_plan(keys_eff, cfg.table_size)
+        plan_all = None
         if kh:
             from xflow_tpu.ops.hot import hot_scatter
 
@@ -635,6 +676,13 @@ class TrainStep:
             # consolidated cold sums destined for hot rows; index H
             # (out of range for the [H, D] buffer) drops the rest
             ukeys_hotpart = jnp.where(in_hot, ukeys, jnp.int32(hsize))
+            if not all(self._mxu_hot.values()):
+                # opted-out tables: one combined plan over cold+hot
+                # occurrence keys (shared by every such table)
+                keys_all = jnp.concatenate(
+                    [keys_eff, self._hot_keys_eff_dma(batch)]
+                )
+                plan_all = consolidate_plan(keys_all, cfg.table_size)
         else:
             ukeys_cold = ukeys
         new_tables = {}
@@ -644,6 +692,22 @@ class TrainStep:
             if kh:
                 hot_g = occ[:, :kh].reshape(-1, d)
                 occ = occ[:, kh:]
+            if kh and not self._mxu_hot[name]:
+                order_a, seg_a, ukeys_a = plan_all
+                gsum_a = consolidate_apply(
+                    jnp.concatenate([occ.reshape(-1, d), hot_g]),
+                    order_a,
+                    seg_a,
+                )
+                state_rows = {
+                    k: gather_rows(arr, ukeys_a) for k, arr in table.items()
+                }
+                new_rows = self.optimizer.update_rows(state_rows, gsum_a)
+                new_tables[name] = {
+                    k: scatter_rows(table[k], ukeys_a, new_rows[k])
+                    for k in table.keys()
+                }
+                continue
             gsum = consolidate_apply(occ.reshape(-1, d), order, seg)
             state_rows = {
                 k: gather_rows(arr, ukeys_cold) for k, arr in table.items()
