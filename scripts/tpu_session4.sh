@@ -50,4 +50,12 @@ for m in fm mvm wide_deep; do
 done
 tail -9 "$OUT/models_sweep.out"
 
+log "3b/3 ffm per-table hot (w on MXU, v on DMA — first hot geometry)"
+for h in 12 14 15; do
+  python scripts/bench_models.py --model ffm --batch-log2 17 \
+      --hot-log2 "$h" \
+      >>"$OUT/ffm_hot.out" 2>>"$OUT/ffm_hot.err"
+done
+tail -3 "$OUT/ffm_hot.out"
+
 log "queue complete — results in $OUT"
