@@ -49,7 +49,7 @@ def _launch_pair(cmd, env, timeout=600, fail_msg="distributed run deadlocked"):
     return procs, errs
 
 
-@pytest.mark.parametrize("hot", [False, True])
+@pytest.mark.parametrize("hot", [False, "dense", "hot"])
 def test_two_process_training(toy_dataset, tmp_path, hot):
     port = _free_port()
     env_base = dict(
@@ -73,12 +73,15 @@ def test_two_process_training(toy_dataset, tmp_path, hot):
     ]
     if hot:
         # compose the hot-table MXU path AND the sequential per-slice
-        # update scan with real 2-process collectives in one
-        # parametrization (the accumulate scan's sharding is covered by
+        # update scan with real 2-process collectives — with the dense
+        # inner and with the hot-fine/cold-coarse inner (scan-carried
+        # head + window-end writeback under GSPMD) (the accumulate
+        # scan's sharding is covered by
         # test_dense_sharded_matches_single on the 8-device mesh)
         cmd += ["--hot-size-log2", "8", "--hot-nnz", "8",
                 "--freq-sample-mib", "1", "--microbatch", "2",
-                "--update-mode", "sequential"]
+                "--update-mode", "sequential",
+                "--sequential-inner", hot]
     else:
         # cover the multi-host checkpoint path (collective allgather
         # save, rank-0 writes) in one of the parametrizations
