@@ -16,19 +16,19 @@ OUT="${1:-/tmp/tpu_r5c}"
 mkdir -p "$OUT"
 log() { echo "[$(date -u +%H:%M:%S)] $*"; }
 
-log "1/4 HEADLINE: time_to_auc lr, hot inner, flagship geometry"
-python scripts/time_to_auc.py --model lr --sequential-inner hot --max-epochs 9 \
-    --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
-    --out docs/artifacts/time_to_auc_lr_hot_flagship.json \
-    >"$OUT/ttauc_hot_flag.out" 2>"$OUT/ttauc_hot_flag.err"
-tail -2 "$OUT/ttauc_hot_flag.out"
-
-log "2/4 hot inner, bigger head (2^14x32): more mass fine-grained"
+log "1/4 HEADLINE: time_to_auc lr, hot inner, 2^14 head (CPU rehearsal crossed at epoch 5 — the strongest candidate runs first in case the tunnel is short-lived)"
 python scripts/time_to_auc.py --model lr --sequential-inner hot --max-epochs 9 \
     --hot-size-log2 14 --hot-nnz 32 --max-nnz 16 \
     --out docs/artifacts/time_to_auc_lr_hot14.json \
     >"$OUT/ttauc_hot14.out" 2>"$OUT/ttauc_hot14.err"
 tail -2 "$OUT/ttauc_hot14.out"
+
+log "2/4 hot inner, flagship geometry (2^12 head; rehearsal says crossing at epoch ~6)"
+python scripts/time_to_auc.py --model lr --sequential-inner hot --max-epochs 9 \
+    --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
+    --out docs/artifacts/time_to_auc_lr_hot_flagship.json \
+    >"$OUT/ttauc_hot_flag.out" 2>"$OUT/ttauc_hot_flag.err"
+tail -2 "$OUT/ttauc_hot_flag.out"
 
 log "2b/4 hot inner, half window (B=65536): halves cold staleness/coarsening"
 python scripts/time_to_auc.py --model lr --sequential-inner hot --max-epochs 9 \
