@@ -32,6 +32,11 @@ Secondary metrics in the same JSON line:
     text->parse->pack->device->train throughput over a generated zipf
     libffm dataset, exercising the real ShardLoader + native parser
     (the reference's whole bottleneck was host IO — SURVEY §7c).
+  - ``input_stall_frac`` / ``e2e_phase_seconds``: per-phase attribution
+    of the e2e loop (input stall vs h2d vs dispatch vs device block) —
+    the same accounting the trainer emits per epoch (xflow_tpu/obs,
+    docs/OBSERVABILITY.md), so a degraded e2e number names its
+    bottleneck instead of just shipping ``degraded: true``.
   - ``e2e_packed_examples_per_sec`` / ``packed_read_examples_per_sec``:
     the steady-state path — text parsed ONCE into the packed-batch
     cache (io/packed.py), epochs 2..N stream device-ready batches over
@@ -295,16 +300,40 @@ def bench_e2e(devices, cfg, data_path: str, result: dict, remap=None) -> None:
     workers = max(1, min(6, (os.cpu_count() or 1) - 1))
     nbytes = os.path.getsize(data_path)
     examples = 0
+    # Per-phase attribution of the e2e loop (ISSUE 1): input_stall is
+    # time blocked on the prefetch iterator (parse+pack hide behind
+    # it), h2d the inline put_batch, dispatch the async train call;
+    # device_block the final drain.  input_stall_frac says whether the
+    # gap between `value` (pure compute) and e2e_examples_per_sec is
+    # the host pipeline or the device path.
+    phase = {"input_stall": 0.0, "h2d": 0.0, "dispatch": 0.0}
+    it = loader.prefetch(depth=2, parse_workers=workers)
     t0 = time.perf_counter()
-    for batch, _ in loader.prefetch(depth=2, parse_workers=workers):
+    while True:
+        t = time.perf_counter()
+        try:
+            batch, _ = next(it)
+        except StopIteration:
+            break
+        phase["input_stall"] += time.perf_counter() - t
+        t = time.perf_counter()
         arrays = step.put_batch(batch)
+        phase["h2d"] += time.perf_counter() - t
+        t = time.perf_counter()
         state, _ = step.train(state, arrays)
+        phase["dispatch"] += time.perf_counter() - t
         examples += batch.num_real()
+    t = time.perf_counter()
     jax.device_get(state["tables"]["w"]["param"][:1, 0])
+    phase["device_block"] = time.perf_counter() - t
     dt = time.perf_counter() - t0
     result["e2e_examples_per_sec"] = round(examples / dt, 1)
     result["e2e_mb_per_sec"] = round(nbytes / dt / 2**20, 1)
     result["e2e_examples"] = examples
+    result["input_stall_frac"] = round(phase["input_stall"] / dt, 4)
+    result["e2e_phase_seconds"] = {
+        k: round(v, 3) for k, v in phase.items()
+    }
     result["native_parser"] = bool(native_available())
 
     # host-only parse+pack rate (no device work): isolates the host

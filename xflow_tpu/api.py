@@ -45,3 +45,13 @@ class XFlow:
 
     def restore(self) -> dict | None:
         return self.trainer.restore()
+
+    def close(self) -> None:
+        """Flush/close observability outputs (metrics JSONL, trace)."""
+        self.trainer.close()
+
+    def __enter__(self) -> "XFlow":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

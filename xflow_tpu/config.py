@@ -83,13 +83,30 @@ class Config:
     num_devices: int = 0
 
     # -- observability (SURVEY §5: reference has stdout only) --
-    # JSONL file receiving one structured record per epoch / eval.
+    # JSONL file receiving structured records (schema: obs/schema.py,
+    # docs/OBSERVABILITY.md): run_start header, per-epoch phase-timed
+    # train_epoch rows, eval, per-shard loader throughput, device
+    # memory.  Setting this also enables the pipeline-health metrics
+    # registry (per-phase seconds, stall accounting, step-time
+    # percentiles).  Summarize with `python -m xflow_tpu.obs summarize`.
     metrics_out: str = ""
     # Capture a jax.profiler trace (viewable in TensorBoard/Perfetto) of
     # profile_steps training steps starting at step profile_start_step.
     profile_dir: str = ""
     profile_steps: int = 5
     profile_start_step: int = 10
+    # Host-side span tracer (obs/trace.py): Chrome trace-event JSON
+    # written here on close ("" = off).  Complements profile_dir — the
+    # XLA profile shows device internals for a few steps; these spans
+    # show the host loop (parse/pack/h2d/dispatch/stall) for the whole
+    # run.  Multi-host appends "-r<rank>".  Open in ui.perfetto.dev.
+    obs_trace_out: str = ""
+    # Span ring-buffer capacity: only the newest N spans are kept, so
+    # long runs cannot grow host memory.
+    obs_trace_capacity: int = 65536
+    # Emit a per-epoch device_mem JSONL row (jax.local_devices()
+    # memory_stats) when metrics_out is set.
+    obs_device_memory: bool = True
 
     # -- eval / artifacts --
     # Prediction dump target.  With pred_style="single" (default) rank 0
@@ -306,6 +323,8 @@ class Config:
             raise ValueError(f"unknown pred_style {self.pred_style!r}")
         if self.wire_mode not in ("auto", "full", "compact"):
             raise ValueError(f"unknown wire_mode {self.wire_mode!r}")
+        if self.obs_trace_capacity < 1:
+            raise ValueError("obs_trace_capacity must be >= 1")
 
     @property
     def table_size(self) -> int:

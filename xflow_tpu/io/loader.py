@@ -25,6 +25,7 @@ from typing import Callable, Iterator
 
 from xflow_tpu.io.batch import Batch, ParsedBlock, pack_batch
 from xflow_tpu.io.libffm import BlockReader, parse_block
+from xflow_tpu.obs import NULL_OBS
 
 
 def shard_path(prefix: str, rank: int) -> str:
@@ -93,6 +94,7 @@ class ShardLoader:
         remap=None,  # int32 [table_size] permutation (io/freq.py), or None
         hot_size: int = 0,
         hot_nnz: int = 0,
+        obs=None,  # obs.Obs: parse/pack phase seconds + byte counters
     ):
         self.path = path
         self.batch_size = batch_size
@@ -109,6 +111,11 @@ class ShardLoader:
         self.remap = remap
         self.hot_size = hot_size
         self.hot_nnz = hot_nnz
+        # Parse/pack run on worker threads under prefetch/parse_workers,
+        # so their phase seconds OVERLAP the consumer's wall-clock — the
+        # trainer reports them in the epoch record's "overlapped" dict,
+        # never in the additive main-thread accounting.
+        self.obs = obs if obs is not None else NULL_OBS
         # Native pack folds remap + hot steering + padding into one C
         # pass (xf_pack_batch); the numpy fallback applies the remap at
         # parse time and pads/steers with pack_batch.
@@ -127,20 +134,25 @@ class ShardLoader:
         return block
 
     def _parse_remap(self, raw: bytes) -> ParsedBlock:
-        return self._apply_remap(self.parse_fn(raw))
+        with self.obs.phase("parse"):
+            block = self._apply_remap(self.parse_fn(raw))
+        self.obs.counter("loader.parse_bytes", len(raw))
+        self.obs.counter("loader.blocks")
+        return block
 
     def _pack(self, block: ParsedBlock, start: int, end: int) -> Batch:
-        if self._native_pack:
-            from xflow_tpu.native import native_pack_batch
+        with self.obs.phase("pack"):
+            if self._native_pack:
+                from xflow_tpu.native import native_pack_batch
 
-            return native_pack_batch(
+                return native_pack_batch(
+                    block, start, end, self.batch_size, self.max_nnz,
+                    self.hot_size, self.hot_nnz, self.remap,
+                )
+            return pack_batch(
                 block, start, end, self.batch_size, self.max_nnz,
-                self.hot_size, self.hot_nnz, self.remap,
+                self.hot_size, self.hot_nnz,
             )
-        return pack_batch(
-            block, start, end, self.batch_size, self.max_nnz,
-            self.hot_size, self.hot_nnz,
-        )
 
     def iter_batches(
         self, start_offset: int = 0, parse_workers: int = 0

@@ -1,0 +1,138 @@
+"""Observability subsystem (ISSUE 1): span tracing, pipeline-health
+metrics, and the trace/summary toolchain.
+
+Three layers, all off-by-default-cheap:
+
+* ``trace`` — nested context-manager spans, ring-buffered, exported as
+  Chrome trace-event JSON for Perfetto (Config.obs_trace_out);
+* ``registry`` — counters/gauges/histograms: per-phase wall-second
+  accounting (parse, pack, h2d, dispatch, input stall, checkpoint,
+  device block), step-time percentiles, transfer-ahead occupancy;
+* ``summary`` / ``__main__`` — ``python -m xflow_tpu.obs summarize
+  run.jsonl`` turns metrics JSONL into phase/throughput tables;
+  ``compare a.jsonl b.jsonl`` diffs two runs.
+
+The ``Obs`` facade bundles one tracer and one registry and is threaded
+through the hot path (Trainer, TrainStep.put_batch, ShardLoader).  When
+disabled, ``NULL_OBS`` is a shared object whose ``phase()`` returns one
+shared no-op context manager — no per-step allocation.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+from xflow_tpu.obs.registry import (
+    NULL_REGISTRY,
+    MetricsRegistry,
+    NullRegistry,
+    Snapshot,
+)
+from xflow_tpu.obs.trace import NULL_SPAN, NULL_TRACER, NullTracer, SpanTracer
+
+__all__ = [
+    "Obs",
+    "NULL_OBS",
+    "make_obs",
+    "SpanTracer",
+    "NullTracer",
+    "MetricsRegistry",
+    "NullRegistry",
+    "Snapshot",
+]
+
+
+class _Phase:
+    """Times a block and books it BOTH as a ``phase.<name>`` counter
+    (wall-second accounting) and as a trace span."""
+
+    __slots__ = ("_obs", "_name", "_t0")
+
+    def __init__(self, obs: "Obs", name: str):
+        self._obs = obs
+        self._name = name
+
+    def __enter__(self) -> "_Phase":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        dt = time.perf_counter() - self._t0
+        self._obs.registry.counter_add("phase." + self._name, dt)
+        self._obs.tracer.add_complete(self._name, self._t0, dt)
+        return None
+
+
+class Obs:
+    """One tracer + one registry, shared by everything in a run."""
+
+    __slots__ = ("tracer", "registry")
+    enabled = True
+
+    def __init__(self, tracer=None, registry=None):
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.registry = (
+            registry if registry is not None else MetricsRegistry()
+        )
+
+    def phase(self, name: str) -> _Phase:
+        return _Phase(self, name)
+
+    def span(self, name: str, tags: dict | None = None):
+        """Trace-only span (no phase counter) — for enclosing scopes
+        like a whole epoch, where counting the seconds would double the
+        inner phases."""
+        return self.tracer.span(name, tags)
+
+    def counter(self, name: str, v: float = 1.0) -> None:
+        self.registry.counter_add(name, v)
+
+    def gauge(self, name: str, v: float) -> None:
+        self.registry.gauge_set(name, v)
+
+    def observe(self, name: str, v: float) -> None:
+        self.registry.observe(name, v)
+
+
+class NullObs:
+    """Disabled facade: every path is a no-op; ``phase``/``span`` return
+    the one shared ``NULL_SPAN`` — zero per-step allocation."""
+
+    __slots__ = ()
+    enabled = False
+    tracer = NULL_TRACER
+    registry = NULL_REGISTRY
+
+    def phase(self, name: str):
+        return NULL_SPAN
+
+    def span(self, name: str, tags: dict | None = None):
+        return NULL_SPAN
+
+    def counter(self, name: str, v: float = 1.0) -> None:
+        pass
+
+    def gauge(self, name: str, v: float) -> None:
+        pass
+
+    def observe(self, name: str, v: float) -> None:
+        pass
+
+
+NULL_OBS = NullObs()
+
+
+def make_obs(
+    trace: bool = False,
+    trace_capacity: int = 65536,
+    rank: int = 0,
+    step_fn: Callable[[], int] | None = None,
+) -> Obs:
+    """Live Obs: registry always, tracer only when ``trace``."""
+    tracer = (
+        SpanTracer(capacity=trace_capacity, rank=rank, step_fn=step_fn)
+        if trace
+        else NULL_TRACER
+    )
+    return Obs(tracer=tracer, registry=MetricsRegistry())

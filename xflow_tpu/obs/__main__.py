@@ -1,0 +1,56 @@
+"""CLI: ``python -m xflow_tpu.obs <summarize|validate|compare> ...``
+
+    summarize run.jsonl      phase/throughput/percentile tables per run
+    compare   a.jsonl b.jsonl  side-by-side diff of the last run in each
+    validate  run.jsonl      strict schema check (exit 1 on violations)
+
+Pure host-side file processing — never imports jax, so it runs
+anywhere (including hosts with no accelerator runtime).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from xflow_tpu.obs.schema import load_jsonl, validate_rows
+from xflow_tpu.obs.summary import compare, summarize
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m xflow_tpu.obs",
+        description="metrics JSONL toolchain (docs/OBSERVABILITY.md)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("summarize", help="per-run phase/throughput tables")
+    ps.add_argument("path")
+    pv = sub.add_parser("validate", help="strict schema check")
+    pv.add_argument("path")
+    pc = sub.add_parser("compare", help="diff the last run of two files")
+    pc.add_argument("path_a")
+    pc.add_argument("path_b")
+    args = p.parse_args(argv)
+
+    if args.cmd == "summarize":
+        print(summarize(args.path))
+        return 0
+    if args.cmd == "validate":
+        errors = validate_rows(load_jsonl(args.path))
+        for e in errors:
+            print(e, file=sys.stderr)
+        print(f"{args.path}: {'FAIL' if errors else 'OK'} "
+              f"({len(errors)} violation(s))")
+        return 1 if errors else 0
+    if args.cmd == "compare":
+        try:
+            print(compare(args.path_a, args.path_b))
+        except ValueError as e:  # empty/headerless file: diagnose, not crash
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        return 0
+    return 2  # unreachable (subparsers required)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,135 @@
+"""Metrics JSONL schema: every ``kind`` and its required fields.
+
+This is the single source of truth for what the trainer emits
+(docs/OBSERVABILITY.md documents the semantics).  Consumed by:
+
+* ``obs.summary`` — tolerant reads, but warns on schema violations;
+* ``scripts/check_metrics_schema.py`` — the CI lint that runs the toy
+  pipeline and validates its output strictly;
+* ``tests/test_observability.py`` — asserts every emitted row passes.
+
+A field listed here must appear in EVERY row of that kind the current
+code emits.  Adding a field is backward-compatible (old files still
+summarize); removing or renaming one is a schema change — update this
+module, the doc, and the lint together.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+# kind -> {field: type-or-tuple-of-types} for isinstance checks.
+# Backend-dependent values (e.g. device memory stats) are OMITTED from
+# their row rather than emitted as null, so no nullable types exist.
+SCHEMA: dict[str, dict[str, Any]] = {
+    # one per MetricsLogger open (run delimiter — summarize splits here)
+    "run_start": {
+        "t": (int, float),
+        "kind": str,
+        "run_id": str,
+        "config_digest": str,
+        "rank": int,
+        "num_hosts": int,
+        "time_unix": (int, float),
+    },
+    # one per training epoch
+    "train_epoch": {
+        "t": (int, float),
+        "kind": str,
+        "epoch": int,
+        "examples": (int, float),
+        "steps": int,
+        "train_logloss": (int, float),
+        "examples_per_sec": (int, float),
+        "seconds": (int, float),
+        "checkpoint_seconds": (int, float),
+        "preempted": bool,
+        # main-thread-exclusive phase seconds: disjoint intervals whose
+        # sum accounts for (nearly all of) `seconds`
+        "phases": dict,
+        # worker-thread phase seconds (parse/pack/h2d under
+        # transfer-ahead): overlap the main thread, NOT additive with it
+        "overlapped": dict,
+        "input_stall_frac": (int, float),
+        "step_time_p50": (int, float),
+        "step_time_p90": (int, float),
+        "step_time_p99": (int, float),
+    },
+    # one per evaluate() call
+    "eval": {
+        "t": (int, float),
+        "kind": str,
+        "epoch": int,
+        "logloss": (int, float),
+        "auc": (int, float),
+        "examples": int,
+        "tp": int,
+        "fp": int,
+        "seconds": (int, float),
+        "phases": dict,
+        "overlapped": dict,
+    },
+    # one per finished training shard (per host; loader throughput)
+    "shard": {
+        "t": (int, float),
+        "kind": str,
+        "epoch": int,
+        "shard": str,
+        "index": int,
+        "examples": int,
+        "seconds": (int, float),
+        "examples_per_sec": (int, float),
+    },
+    # one per epoch: jax.local_devices() memory stats
+    "device_mem": {
+        "t": (int, float),
+        "kind": str,
+        "epoch": int,
+        "devices": list,
+    },
+}
+
+
+def validate_row(row: dict, lineno: int | None = None) -> list[str]:
+    """Schema errors for one parsed JSONL row ([] = valid)."""
+    where = f"line {lineno}: " if lineno is not None else ""
+    kind = row.get("kind")
+    if kind is None:
+        return [f"{where}row has no 'kind' field"]
+    spec = SCHEMA.get(kind)
+    if spec is None:
+        return [f"{where}unknown kind {kind!r}"]
+    errors = []
+    for name, types in spec.items():
+        if name not in row:
+            errors.append(f"{where}kind {kind!r} missing field {name!r}")
+            continue
+        if not isinstance(row[name], types):
+            errors.append(
+                f"{where}kind {kind!r} field {name!r}: expected "
+                f"{types}, got {type(row[name]).__name__}"
+            )
+    return errors
+
+
+def validate_rows(rows: Iterable[dict]) -> list[str]:
+    errors = []
+    for i, row in enumerate(rows, 1):
+        errors.extend(validate_row(row, lineno=i))
+    return errors
+
+
+def load_jsonl(path: str) -> list[dict]:
+    """Parse a metrics file; raises ValueError on a malformed line."""
+    rows = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError as e:
+                raise ValueError(f"{path}:{i}: not valid JSON: {e}")
+    return rows

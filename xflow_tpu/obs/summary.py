@@ -1,0 +1,248 @@
+"""Turn metrics JSONL files into human-readable throughput / stall /
+percentile tables (the ``python -m xflow_tpu.obs`` toolchain).
+
+A metrics file may hold several runs appended back to back; each run
+starts with its ``run_start`` header row (utils/logging.MetricsLogger),
+so runs are never silently merged.  Rows before the first header (files
+written by pre-schema versions) form one anonymous run.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from xflow_tpu.obs.schema import load_jsonl, validate_rows
+
+
+@dataclass
+class Run:
+    header: dict | None = None
+    rows: list = field(default_factory=list)
+
+    def kind(self, kind: str) -> list[dict]:
+        return [r for r in self.rows if r.get("kind") == kind]
+
+    @property
+    def epochs(self) -> list[dict]:
+        return self.kind("train_epoch")
+
+    @property
+    def evals(self) -> list[dict]:
+        return self.kind("eval")
+
+    @property
+    def shards(self) -> list[dict]:
+        return self.kind("shard")
+
+    def label(self) -> str:
+        if not self.header:
+            return "(no run_start header — pre-schema file?)"
+        h = self.header
+        return (
+            f"run {h.get('run_id', '?')}  config {h.get('config_digest', '?')}"
+            f"  rank {h.get('rank', '?')}/{h.get('num_hosts', '?')} hosts"
+        )
+
+    def wall_seconds(self) -> float:
+        return sum(e.get("seconds", 0.0) for e in self.epochs)
+
+    def phase_totals(self) -> tuple[dict[str, float], dict[str, float]]:
+        """(exclusive main-thread phases, overlapped worker phases)
+        summed over the run's epochs."""
+        phases: dict[str, float] = {}
+        overlapped: dict[str, float] = {}
+        for e in self.epochs:
+            for k, v in (e.get("phases") or {}).items():
+                phases[k] = phases.get(k, 0.0) + float(v)
+            for k, v in (e.get("overlapped") or {}).items():
+                overlapped[k] = overlapped.get(k, 0.0) + float(v)
+        return phases, overlapped
+
+    def throughput(self) -> float:
+        """Overall examples/sec over compute time (checkpoint saves
+        excluded, matching train_epoch.examples_per_sec semantics)."""
+        ex = sum(e.get("examples", 0.0) for e in self.epochs)
+        dt = sum(
+            max(e.get("seconds", 0.0) - e.get("checkpoint_seconds", 0.0), 0.0)
+            for e in self.epochs
+        )
+        return ex / dt if dt > 0 else 0.0
+
+    def stall_frac(self) -> float:
+        wall = self.wall_seconds()
+        stall = self.phase_totals()[0].get("input_stall", 0.0)
+        return stall / wall if wall > 0 else 0.0
+
+
+def split_runs(rows: list[dict]) -> list[Run]:
+    runs: list[Run] = []
+    for row in rows:
+        if row.get("kind") == "run_start" or not runs:
+            if row.get("kind") == "run_start":
+                runs.append(Run(header=row))
+                continue
+            runs.append(Run())
+        runs[-1].rows.append(row)
+    return runs
+
+
+def load_runs(path: str) -> list[Run]:
+    return split_runs(load_jsonl(path))
+
+
+def _fmt_row(cols: list, widths: list[int]) -> str:
+    return "  ".join(str(c).rjust(w) for c, w in zip(cols, widths))
+
+
+def format_run(run: Run) -> str:
+    out = [run.label()]
+    epochs = run.epochs
+    if epochs:
+        widths = [5, 10, 11, 10, 7, 8, 8, 7]
+        out.append(_fmt_row(
+            ["epoch", "examples", "ex/s", "logloss", "stall%",
+             "p50ms", "p99ms", "ckpt_s"],
+            widths,
+        ))
+        for e in epochs:
+            out.append(_fmt_row(
+                [
+                    e.get("epoch", "?"),
+                    int(e.get("examples", 0)),
+                    f"{e.get('examples_per_sec', 0.0):.0f}",
+                    f"{e.get('train_logloss', float('nan')):.6f}",
+                    f"{100 * e.get('input_stall_frac', 0.0):.1f}",
+                    f"{1e3 * e.get('step_time_p50', 0.0):.2f}",
+                    f"{1e3 * e.get('step_time_p99', 0.0):.2f}",
+                    f"{e.get('checkpoint_seconds', 0.0):.2f}",
+                ],
+                widths,
+            ))
+        phases, overlapped = run.phase_totals()
+        wall = run.wall_seconds()
+        if phases and wall > 0:
+            out.append("")
+            out.append(_fmt_row(["phase", "seconds", "% wall"], [16, 9, 7]))
+            accounted = 0.0
+            for name, secs in sorted(
+                phases.items(), key=lambda kv: -kv[1]
+            ):
+                accounted += secs
+                out.append(_fmt_row(
+                    [name, f"{secs:.3f}", f"{100 * secs / wall:.1f}"],
+                    [16, 9, 7],
+                ))
+            out.append(_fmt_row(
+                ["accounted", f"{accounted:.3f}",
+                 f"{100 * accounted / wall:.1f}"],
+                [16, 9, 7],
+            ))
+            if overlapped:
+                items = ", ".join(
+                    f"{k} {v:.3f}s"
+                    for k, v in sorted(overlapped.items(), key=lambda kv: -kv[1])
+                )
+                out.append(f"overlapped (worker threads, not additive): {items}")
+    for ev in run.evals:
+        out.append(
+            f"eval epoch {ev.get('epoch', '?')}: "
+            f"logloss={ev.get('logloss', float('nan')):.6f} "
+            f"auc={ev.get('auc', float('nan')):.6f} "
+            f"examples={ev.get('examples', 0)}"
+        )
+    shards = run.shards
+    if shards:
+        rates = [s.get("examples_per_sec", 0.0) for s in shards]
+        out.append(
+            f"shards: {len(shards)} finished, loader throughput "
+            f"min/mean/max = {min(rates):.0f}/"
+            f"{sum(rates) / len(rates):.0f}/{max(rates):.0f} ex/s"
+        )
+    mem = run.kind("device_mem")
+    if mem:
+        last = mem[-1].get("devices") or []
+        used = [
+            d.get("bytes_in_use") for d in last
+            if isinstance(d, dict) and d.get("bytes_in_use") is not None
+        ]
+        if used:
+            out.append(
+                f"device memory (last epoch): "
+                f"{sum(used) / 2**20:.1f} MiB in use across "
+                f"{len(used)} device(s)"
+            )
+    return "\n".join(out)
+
+
+def summarize(path: str) -> str:
+    rows = load_jsonl(path)
+    runs = split_runs(rows)
+    parts = [f"{path}: {len(rows)} rows, {len(runs)} run(s)"]
+    errors = validate_rows(rows)
+    if errors:
+        parts.append(
+            f"WARNING: {len(errors)} schema violation(s), first: {errors[0]}"
+        )
+    for i, run in enumerate(runs):
+        parts.append("")
+        parts.append(f"-- run {i + 1} of {len(runs)} --")
+        parts.append(format_run(run))
+    return "\n".join(parts)
+
+
+def _last_run(path: str) -> Run:
+    runs = load_runs(path)
+    if not runs:
+        raise ValueError(f"{path}: no metrics rows to compare")
+    return runs[-1]
+
+
+def compare(path_a: str, path_b: str) -> str:
+    """Side-by-side comparison of the LAST run in each file."""
+    ra = _last_run(path_a)
+    rb = _last_run(path_b)
+    out = [f"A: {path_a}  ({ra.label()})", f"B: {path_b}  ({rb.label()})", ""]
+
+    def delta(a: float, b: float) -> str:
+        if a == 0:
+            return "n/a"
+        return f"{100.0 * (b - a) / a:+.1f}%"
+
+    widths = [22, 12, 12, 8]
+    out.append(_fmt_row(["metric", "A", "B", "delta"], widths))
+    tp_a, tp_b = ra.throughput(), rb.throughput()
+    out.append(_fmt_row(
+        ["examples/sec", f"{tp_a:.0f}", f"{tp_b:.0f}", delta(tp_a, tp_b)],
+        widths,
+    ))
+    st_a, st_b = ra.stall_frac(), rb.stall_frac()
+    out.append(_fmt_row(
+        ["input_stall_frac", f"{st_a:.3f}", f"{st_b:.3f}",
+         delta(st_a, st_b)],
+        widths,
+    ))
+    wall_a, wall_b = ra.wall_seconds(), rb.wall_seconds()
+    out.append(_fmt_row(
+        ["wall seconds", f"{wall_a:.2f}", f"{wall_b:.2f}",
+         delta(wall_a, wall_b)],
+        widths,
+    ))
+    pa, _ = ra.phase_totals()
+    pb, _ = rb.phase_totals()
+    for name in sorted(set(pa) | set(pb)):
+        a, b = pa.get(name, 0.0), pb.get(name, 0.0)
+        out.append(_fmt_row(
+            [f"phase.{name} (s)", f"{a:.3f}", f"{b:.3f}", delta(a, b)],
+            widths,
+        ))
+    ll = [
+        (r.evals[-1] if r.evals else None) for r in (ra, rb)
+    ]
+    if ll[0] and ll[1]:
+        out.append(_fmt_row(
+            ["eval auc", f"{ll[0].get('auc', 0.0):.6f}",
+             f"{ll[1].get('auc', 0.0):.6f}",
+             delta(ll[0].get("auc", 0.0), ll[1].get("auc", 0.0))],
+            widths,
+        ))
+    return "\n".join(out)

@@ -1,0 +1,178 @@
+"""Low-overhead span tracer: nested context-manager spans exported as
+Chrome trace-event JSON (viewable in Perfetto / chrome://tracing).
+
+Complements — does not replace — the ``jax.profiler`` window
+(Config.profile_dir): the XLA profile shows device-internal time for a
+few steps; these spans show where the HOST loop's wall-clock goes
+(parse, pack, h2d transfer, dispatch, stalls) for the whole run, at
+~microsecond overhead per span.
+
+Design constraints (ISSUE 1):
+
+* ring-buffered — a fixed ``capacity`` of newest events, so an
+  arbitrarily long run cannot grow host memory;
+* rank/step-tagged — ``pid`` is the host rank (one Perfetto process row
+  per host), every event's args carry the trainer's global step;
+* disabled == free — ``NULL_TRACER`` returns one shared no-op span
+  object; no allocation, no clock read, per call.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+
+class _NullSpan:
+    """Shared no-op context manager (the disabled-tracer span)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        return None
+
+
+NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """Disabled tracer: every method is a no-op and ``span`` returns the
+    one shared ``NULL_SPAN`` — nothing is allocated per step."""
+
+    __slots__ = ()
+    enabled = False
+
+    def span(self, name: str, tags: dict | None = None) -> _NullSpan:
+        return NULL_SPAN
+
+    def add_complete(
+        self, name: str, t0: float, dur: float, tags: dict | None = None
+    ) -> None:
+        pass
+
+    def instant(self, name: str, tags: dict | None = None) -> None:
+        pass
+
+    def events(self) -> list[dict]:
+        return []
+
+    def export_chrome(self, path: str) -> str | None:
+        return None
+
+
+NULL_TRACER = NullTracer()
+
+
+class _Span:
+    """One live span: records a Chrome 'X' (complete) event on exit.
+    Nesting is implicit — an inner span's [ts, ts+dur) interval lies
+    inside its enclosing span's, which is exactly how Perfetto stacks
+    same-tid events."""
+
+    __slots__ = ("_tracer", "_name", "_tags", "_t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, tags: dict | None):
+        self._tracer = tracer
+        self._name = name
+        self._tags = tags
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._tracer.add_complete(
+            self._name, self._t0, time.perf_counter() - self._t0, self._tags
+        )
+        return None
+
+
+class SpanTracer:
+    """Ring-buffered recorder of Chrome trace events.
+
+    Thread-safe by construction: events append to a ``deque(maxlen=...)``
+    (atomic under the GIL); the tid map takes a lock only on the first
+    event from a new thread.
+    """
+
+    enabled = True
+
+    def __init__(
+        self,
+        capacity: int = 65536,
+        rank: int = 0,
+        step_fn: Callable[[], int] | None = None,
+    ):
+        self.capacity = capacity
+        self.rank = rank
+        self._step_fn = step_fn
+        self._t0 = time.perf_counter()
+        self._events: deque = deque(maxlen=capacity)
+        self._tids: dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            with self._lock:
+                tid = self._tids.setdefault(ident, len(self._tids))
+        return tid
+
+    def span(self, name: str, tags: dict | None = None) -> _Span:
+        return _Span(self, name, tags)
+
+    def add_complete(
+        self, name: str, t0: float, dur: float, tags: dict | None = None
+    ) -> None:
+        """Record a finished [t0, t0+dur) span (perf_counter seconds)."""
+        args: dict[str, Any] = dict(tags) if tags else {}
+        if self._step_fn is not None:
+            args["step"] = self._step_fn()
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": round((t0 - self._t0) * 1e6, 3),  # Chrome wants µs
+            "dur": round(dur * 1e6, 3),
+            "pid": self.rank,
+            "tid": self._tid(),
+        }
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+
+    def instant(self, name: str, tags: dict | None = None) -> None:
+        """Zero-duration marker (Chrome 'i' event)."""
+        args: dict[str, Any] = dict(tags) if tags else {}
+        if self._step_fn is not None:
+            args["step"] = self._step_fn()
+        ev = {
+            "name": name,
+            "ph": "i",
+            "s": "t",
+            "ts": round((time.perf_counter() - self._t0) * 1e6, 3),
+            "pid": self.rank,
+            "tid": self._tid(),
+        }
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+
+    def events(self) -> list[dict]:
+        return list(self._events)
+
+    def export_chrome(self, path: str) -> str:
+        """Write the buffered events as a Chrome trace-event JSON object
+        ({"traceEvents": [...]}); open with Perfetto (ui.perfetto.dev)
+        or chrome://tracing."""
+        with open(path, "w") as f:
+            json.dump(
+                {"traceEvents": self.events(), "displayTimeUnit": "ms"}, f
+            )
+        return path

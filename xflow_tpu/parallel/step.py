@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from xflow_tpu.config import Config
 from xflow_tpu.io.batch import Batch
 from xflow_tpu.models.base import BatchArrays, Model
+from xflow_tpu.obs import NULL_OBS
 from xflow_tpu.ops.sparse import (
     consolidate_apply,
     consolidate_plan,
@@ -253,12 +254,24 @@ class TrainStep:
             )
         self.compact_wire = cfg.wire_mode != "full" and compact_ok
         self._compact_validated = False
+        # Observability hook (obs/__init__.py): the trainer swaps in a
+        # live Obs; the default NULL_OBS makes every span a shared no-op
+        # object, so direct users (bench.py run()) pay nothing.
+        self.obs = NULL_OBS
         self.train = jax.jit(self._train_impl, donate_argnums=0)
         self.predict = jax.jit(self._predict_impl)
 
     # -- helpers -----------------------------------------------------------
 
     def put_batch(self, batch: Batch) -> BatchArrays:
+        """Host->device transfer, booked as the 'h2d' phase.  Under
+        trainer._transfer_ahead this runs on a worker thread and the
+        seconds land in the epoch record's overlapped dict; called
+        inline (multi-host, eval) they are main-thread-exclusive."""
+        with self.obs.phase("h2d"):
+            return self._put_batch_impl(batch)
+
+    def _put_batch_impl(self, batch: Batch) -> BatchArrays:
         if self.compact_wire:
             arrays = batch_to_compact(
                 batch,
@@ -283,6 +296,17 @@ class TrainStep:
         return {
             k: jax.device_put(v, self._bsharding) for k, v in arrays.items()
         }
+
+    def dispatch_train(
+        self, state: State, arrays: BatchArrays
+    ) -> tuple[State, dict[str, jax.Array]]:
+        """The jitted train call under the 'dispatch' phase.  Dispatch
+        returns as soon as XLA enqueues the program; time the device
+        spends actually computing surfaces later as 'device_block' (the
+        epoch-end metrics fetch) — the dispatch/block split is what
+        tells an input-bound run from a compute-bound one."""
+        with self.obs.phase("dispatch"):
+            return self.train(state, arrays)
 
     def _expand_wire(self, batch: BatchArrays) -> BatchArrays:
         """Inverse of batch_to_compact, inside the jitted step: padding

@@ -115,7 +115,20 @@ def build_parser() -> argparse.ArgumentParser:
         "pred_<rank>_<block>.txt per eval batch (reference artifact "
         "granularity, lr_worker.cc:74-78)",
     )
-    p.add_argument("--metrics-out", dest="metrics_out")
+    p.add_argument(
+        "--metrics-out", dest="metrics_out",
+        help="structured metrics JSONL (schema: obs/schema.py); "
+        "summarize with `python -m xflow_tpu.obs summarize FILE`",
+    )
+    p.add_argument(
+        "--obs-trace-out", dest="obs_trace_out",
+        help="host-side span trace (Chrome trace-event JSON for "
+        "Perfetto) written here on exit",
+    )
+    p.add_argument(
+        "--obs-trace-capacity", type=int, dest="obs_trace_capacity",
+        help="span ring-buffer size (newest N spans kept)",
+    )
     p.add_argument("--profile-dir", dest="profile_dir")
     p.add_argument("--profile-steps", type=int, dest="profile_steps")
     p.add_argument("--profile-start-step", type=int, dest="profile_start_step")
@@ -188,20 +201,23 @@ def main(argv: list[str] | None = None) -> int:
     if not cfg.train_path:
         print("error: --train is required", file=sys.stderr)
         return 2
-    trainer = Trainer(cfg)
-    if args.resume:
-        cursor = trainer.restore()
-        if cursor:
-            print(f"resumed at {cursor}", file=sys.stderr)
-    history = trainer.train()
-    if history and history[-1].get("preempted"):
-        print(
-            "preempted: checkpoint saved, resume with --resume",
-            file=sys.stderr,
-        )
-        return 0
-    if cfg.test_path and not args.skip_eval:
-        trainer.evaluate()
+    # context manager: metrics JSONL + trace are flushed/closed on every
+    # exit path, including exceptions (the logger itself also closes on
+    # train()'s own preemption/crash paths)
+    with Trainer(cfg) as trainer:
+        if args.resume:
+            cursor = trainer.restore()
+            if cursor:
+                print(f"resumed at {cursor}", file=sys.stderr)
+        history = trainer.train()
+        if history and history[-1].get("preempted"):
+            print(
+                "preempted: checkpoint saved, resume with --resume",
+                file=sys.stderr,
+            )
+            return 0
+        if cfg.test_path and not args.skip_eval:
+            trainer.evaluate()
     return 0
 
 

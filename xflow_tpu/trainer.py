@@ -31,6 +31,7 @@ from xflow_tpu.config import Config
 from xflow_tpu.io.batch import Batch
 from xflow_tpu.io.loader import ShardLoader, make_parse_fn, shard_path
 from xflow_tpu.models import make_model
+from xflow_tpu.obs import NULL_OBS
 from xflow_tpu.optim import make_optimizer
 from xflow_tpu.parallel.mesh import make_mesh
 from xflow_tpu.parallel.step import TrainStep, init_state
@@ -80,18 +81,35 @@ class Trainer:
         # restore(), consumed by the first train_epoch() after it.
         self._resume_cursor: tuple[int, int] = (0, 0)
         self._log = log if log is not None else lambda s: print(s, file=sys.stderr)
-        self.metrics_logger = None
-        if cfg.metrics_out and jax.process_index() == 0:
-            from xflow_tpu.utils.logging import MetricsLogger
-
-            self.metrics_logger = MetricsLogger(cfg.metrics_out)
-        self._profiled = False
-        self._preempted = False
-        self._preempt_agreed = False
-        self._global_steps = 0  # across epochs; drives the profile trigger
         # Multi-host: each process reads its own shard subset.
         self.host = jax.process_index()
         self.num_hosts = jax.process_count()
+        self._global_steps = 0  # across epochs; drives the profile trigger
+        # Observability (obs/__init__.py): a live tracer/registry bundle
+        # when metrics or tracing is requested, else the shared no-op
+        # NULL_OBS (zero per-step allocation).  Threaded into the step
+        # (put_batch/dispatch phases) and every loader (parse/pack).
+        self.obs = NULL_OBS
+        if cfg.metrics_out or cfg.obs_trace_out:
+            from xflow_tpu.obs import make_obs
+
+            self.obs = make_obs(
+                trace=bool(cfg.obs_trace_out),
+                trace_capacity=cfg.obs_trace_capacity,
+                rank=self.host,
+                step_fn=lambda: self._global_steps,
+            )
+        self.step.obs = self.obs
+        self.metrics_logger = None
+        if cfg.metrics_out and self.host == 0:
+            from xflow_tpu.utils.logging import MetricsLogger
+
+            self.metrics_logger = MetricsLogger(
+                cfg.metrics_out, run_header=self._run_header()
+            )
+        self._profiled = False
+        self._preempted = False
+        self._preempt_agreed = False
         # Hot-table frequency remap (io/freq.py): loaded from the
         # checkpoint dir when present, else measured from a deterministic
         # sample of the training data (identical on every host).
@@ -110,6 +128,50 @@ class Trainer:
                         "with a hot table; set hot_size_log2 to match "
                         "(or use a fresh checkpoint_dir)"
                     )
+
+    # -- observability lifecycle -------------------------------------------
+
+    def _run_header(self) -> dict:
+        """Contents of the metrics file's ``run_start`` delimiter row:
+        enough to tell two appended runs apart (the file opens in append
+        mode) and to check their configs match without any log parsing."""
+        import hashlib
+
+        return {
+            "run_id": f"{int(time.time() * 1000):x}-{os.getpid():x}",
+            "config_digest": hashlib.sha256(
+                self.cfg.to_json().encode()
+            ).hexdigest()[:12],
+            "rank": self.host,
+            "num_hosts": self.num_hosts,
+            "model": self.cfg.model,
+        }
+
+    def close(self) -> None:
+        """Flush-and-close observability outputs: the metrics JSONL and
+        (when tracing) the Chrome trace export.  Idempotent.  train()
+        calls it on its exception and preemption paths; use the Trainer
+        as a context manager (or call this) to cover every other exit."""
+        self._export_trace()
+        if self.metrics_logger is not None:
+            self.metrics_logger.close()
+
+    def _export_trace(self) -> None:
+        if not (self.cfg.obs_trace_out and self.obs.tracer.enabled):
+            return
+        path = self.cfg.obs_trace_out
+        if self.num_hosts > 1:
+            path = f"{path}-r{self.host}"
+        try:
+            self.obs.tracer.export_chrome(path)
+        except OSError as e:
+            self._log(f"trace export failed: {e}")
+
+    def __enter__(self) -> "Trainer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
 
     def _remap_path(self) -> str | None:
         if not self.cfg.checkpoint_dir:
@@ -190,6 +252,7 @@ class Trainer:
             remap=self.remap,
             hot_size=cfg.hot_size,
             hot_nnz=cfg.hot_nnz,
+            obs=self.obs,
         )
 
     def _parse_workers(self) -> int:
@@ -205,7 +268,12 @@ class Trainer:
     def iter_train_batches(
         self, start_shard: int = 0, start_offset: int = 0
     ) -> Iterator[tuple[Batch, int, int]]:
-        """Yields (batch, shard_index, resume_offset) over one epoch."""
+        """Yields (batch, shard_index, resume_offset) over one epoch.
+
+        When metrics are on, each finished shard logs a ``shard`` row
+        with its observed loader throughput — wall-clock measured at
+        the consumer, so it includes parse + pack + any consumer
+        backpressure: the rate the training loop actually saw."""
         shards = self._my_shards(self.cfg.train_path)
         depth = self.cfg.prefetch_batches
         for si, path in enumerate(shards):
@@ -219,8 +287,21 @@ class Trainer:
                 if depth
                 else loader.iter_batches(offset, workers)
             )
+            t_shard = time.perf_counter()
+            examples = 0
             for batch, resume in it:
+                examples += batch.num_real()
                 yield batch, si, resume
+            dt = time.perf_counter() - t_shard
+            if self.metrics_logger is not None:
+                self.metrics_logger.log("shard", {
+                    "epoch": self.epoch,
+                    "shard": os.path.basename(path),
+                    "index": si,
+                    "examples": examples,
+                    "seconds": round(dt, 3),
+                    "examples_per_sec": round(examples / max(dt, 1e-9), 1),
+                })
 
     def _empty_batch(self) -> Batch:
         """All-padding batch (weights/mask 0): a no-op training step with
@@ -314,6 +395,10 @@ class Trainer:
                 pending.append(
                     (ex.submit(self.step.put_batch, batch), si, resume)
                 )
+                # queue occupancy: steadily == depth+1 means the device
+                # is the bottleneck; hovering at 0-1 means the consumer
+                # drains transfers as fast as they arrive (input-bound)
+                self.obs.observe("transfer_ahead_depth", len(pending))
                 if len(pending) > depth:
                     fut, psi, presume = pending.popleft()
                     yield fut.result(), psi, presume
@@ -352,10 +437,32 @@ class Trainer:
 
     # -- training ----------------------------------------------------------
 
+    def _stop_profile(self, flush_metric) -> None:
+        """The ONE jax.profiler.stop_trace site.  The flush-then-stop
+        invariant lives here: dispatch is async, so without blocking on
+        a step metric first the trace would close before the profiled
+        steps' device work ran."""
+        if flush_metric is not None:
+            jax.device_get(flush_metric["logloss"])  # flush pending work
+        jax.profiler.stop_trace()
+        self._profiled = True
+
+    def _timed_save(self, shard_idx: int, offset: int) -> float:
+        """save() booked as the 'checkpoint' phase; returns the seconds
+        so train_epoch reports checkpoint_seconds separately instead of
+        letting saves silently deflate examples_per_sec."""
+        t0 = time.perf_counter()
+        with self.obs.phase("checkpoint"):
+            self.save(shard_idx, offset)
+        return time.perf_counter() - t0
+
     def train_epoch(self, start_shard: int = 0, start_offset: int = 0) -> dict:
         cfg = self.cfg
+        obs = self.obs
+        obs.registry.reset()  # epoch-scoped phase accounting
         t0 = time.time()
         steps = 0
+        ckpt_seconds = 0.0
         preempted = False
         device_metrics = []  # fetched once at epoch end to keep dispatch async
         profiling = False
@@ -370,60 +477,123 @@ class Trainer:
         ahead = self.num_hosts == 1
         if ahead:
             stream = self._transfer_ahead(stream)
-        for batch, shard_idx, resume in stream:
-            last_cursor = (shard_idx, resume)
-            if (
-                cfg.profile_dir
-                and not self._profiled
-                and self._global_steps >= cfg.profile_start_step
-                and not profiling
-            ):
-                jax.profiler.start_trace(cfg.profile_dir)
-                profiling = True
-                profile_end = self._global_steps + cfg.profile_steps
-            arrays = batch if ahead else self.step.put_batch(batch)
-            self.state, metrics = self.step.train(self.state, arrays)
-            steps += 1
-            self._global_steps += 1
-            device_metrics.append(metrics)
-            if profiling and self._global_steps >= profile_end:
-                jax.device_get(metrics["logloss"])  # flush pending work
-                jax.profiler.stop_trace()
-                profiling = False
-                self._profiled = True
-            if cfg.checkpoint_dir and cfg.checkpoint_every_steps and (
-                steps % cfg.checkpoint_every_steps == 0
-            ):
-                self.save(shard_idx, resume)
-            if self.num_hosts == 1 and self._preempted:
-                self.save(shard_idx, resume)
+        it = iter(stream)
+        with obs.span("train_epoch", {"epoch": self.epoch}):
+            while True:
+                t_step = time.perf_counter()
+                try:
+                    # waiting on the input iterator IS the input stall:
+                    # with transfer-ahead/prefetch on, parse, pack and
+                    # h2d all hide behind this wait; whatever doesn't
+                    # overlap device time surfaces here
+                    with obs.phase("input_stall"):
+                        batch, shard_idx, resume = next(it)
+                except StopIteration:
+                    break
+                last_cursor = (shard_idx, resume)
+                if (
+                    cfg.profile_dir
+                    and not self._profiled
+                    and self._global_steps >= cfg.profile_start_step
+                    and not profiling
+                ):
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
+                    profile_end = self._global_steps + cfg.profile_steps
+                arrays = batch if ahead else self.step.put_batch(batch)
+                self.state, metrics = self.step.dispatch_train(
+                    self.state, arrays
+                )
+                obs.observe("step_seconds", time.perf_counter() - t_step)
+                steps += 1
+                self._global_steps += 1
+                device_metrics.append(metrics)
+                if profiling and self._global_steps >= profile_end:
+                    self._stop_profile(metrics)
+                    profiling = False
+                if cfg.checkpoint_dir and cfg.checkpoint_every_steps and (
+                    steps % cfg.checkpoint_every_steps == 0
+                ):
+                    ckpt_seconds += self._timed_save(shard_idx, resume)
+                if self.num_hosts == 1 and self._preempted:
+                    ckpt_seconds += self._timed_save(shard_idx, resume)
+                    preempted = True
+                    break
+            if self._preempt_agreed:
+                # multi-host: every process left the loop at the same
+                # step; the (collective) save is safe here
+                ckpt_seconds += self._timed_save(*last_cursor)
                 preempted = True
-                break
-        if self._preempt_agreed:
-            # multi-host: every process left the loop at the same step;
-            # the (collective) save is safe here
-            self.save(*last_cursor)
-            preempted = True
-        if profiling:  # epoch ended inside the profile window
-            if device_metrics:
-                jax.device_get(device_metrics[-1]["logloss"])  # flush
-            jax.profiler.stop_trace()
-            self._profiled = True
-        host_metrics = jax.device_get(device_metrics)
+            if profiling:  # epoch ended inside the profile window
+                self._stop_profile(
+                    device_metrics[-1] if device_metrics else None
+                )
+            with obs.phase("device_block"):
+                host_metrics = jax.device_get(device_metrics)
         seen = float(sum(m["count"] for m in host_metrics))
         ll_sum = float(
             sum(m["logloss"] * m["count"] for m in host_metrics)
         )
         dt = time.time() - t0
-        return {
+        return self._epoch_stats(
+            seen, ll_sum, steps, dt, ckpt_seconds, preempted, ahead
+        )
+
+    def _epoch_stats(
+        self,
+        seen: float,
+        ll_sum: float,
+        steps: int,
+        dt: float,
+        ckpt_seconds: float,
+        preempted: bool,
+        ahead: bool,
+    ) -> dict:
+        """Epoch record assembly: throughput (checkpoint time excluded),
+        per-phase wall-second accounting, stall fraction, step-time
+        percentiles.  Phase semantics (docs/OBSERVABILITY.md): `phases`
+        holds main-thread-EXCLUSIVE intervals whose sum accounts for
+        (nearly all of) `seconds`; `overlapped` holds worker-thread
+        phases (parse/pack, and h2d under transfer-ahead) that hide
+        behind input_stall and must not be added to the wall-clock."""
+        snap = self.obs.registry.snapshot(reset=True)
+        phases = snap.phase_seconds()
+        overlapped = {
+            k: round(phases.pop(k), 6)
+            for k in ("parse", "pack") if k in phases
+        }
+        if ahead and "h2d" in phases:
+            overlapped["h2d"] = round(phases.pop("h2d"), 6)
+        phases = {k: round(v, 6) for k, v in phases.items()}
+        step_hist = snap.hists.get("step_seconds", {})
+        stats = {
             "epoch": self.epoch,
             "examples": seen,
             "steps": steps,
             "train_logloss": ll_sum / max(seen, 1.0),
-            "examples_per_sec": seen / max(dt, 1e-9),
+            "examples_per_sec": seen / max(dt - ckpt_seconds, 1e-9),
             "seconds": dt,
+            "checkpoint_seconds": round(ckpt_seconds, 6),
             "preempted": preempted,
+            "phases": phases,
+            "overlapped": overlapped,
+            "input_stall_frac": round(
+                phases.get("input_stall", 0.0) / max(dt, 1e-9), 6
+            ),
+            "step_time_p50": round(step_hist.get("p50", 0.0), 6),
+            "step_time_p90": round(step_hist.get("p90", 0.0), 6),
+            "step_time_p99": round(step_hist.get("p99", 0.0), 6),
         }
+        occ = snap.hists.get("transfer_ahead_depth")
+        if occ:
+            stats["transfer_ahead_depth_mean"] = round(occ["mean"], 3)
+        if "loader.parse_bytes" in snap.counters:
+            stats["parse_mb_per_sec"] = round(
+                snap.counters["loader.parse_bytes"] / 2**20
+                / max(overlapped.get("parse", 0.0), 1e-9),
+                2,
+            )
+        return stats
 
     def train(self) -> list[dict]:
         """Full training run (reference batch_training loop over epochs,
@@ -446,12 +616,16 @@ class Trainer:
                 history.append(stats)
                 if self.metrics_logger is not None:
                     self.metrics_logger.log("train_epoch", stats)
+                self._log_device_mem()
                 if self.epoch % 30 == 0 or self.epoch == self.cfg.epochs - 1:
                     self._log(
                         f"epoch {self.epoch}: logloss={stats['train_logloss']:.6f} "
                         f"examples/s={stats['examples_per_sec']:.0f}"
                     )
                 if stats.get("preempted"):
+                    # the process is about to exit for a restart: flush
+                    # the metrics file and trace NOW
+                    self.close()
                     break
                 self.epoch += 1
                 if self.cfg.checkpoint_dir:
@@ -463,9 +637,40 @@ class Trainer:
                     and self.epoch % self.cfg.eval_every_epochs == 0
                 ):
                     self.evaluate()
+        except BaseException:
+            # crash path: never lose buffered metrics rows or the trace
+            self.close()
+            raise
         finally:
             restore_handlers()
         return history
+
+    def _log_device_mem(self) -> None:
+        """Per-epoch jax.local_devices() memory gauge (``device_mem``
+        row).  memory_stats() is unsupported on some backends (CPU
+        returns None/raises) — the row still lands with whatever fields
+        exist, so the schema stays uniform across backends."""
+        if self.metrics_logger is None or not self.cfg.obs_device_memory:
+            return
+        devices = []
+        for d in jax.local_devices():
+            entry: dict[str, Any] = {
+                "id": int(d.id), "platform": str(d.platform),
+            }
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                ms = None
+            if ms:
+                for key in (
+                    "bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                ):
+                    if key in ms:
+                        entry[key] = int(ms[key])
+            devices.append(entry)
+        self.metrics_logger.log(
+            "device_mem", {"epoch": self.epoch, "devices": devices}
+        )
 
     def _install_preemption_handler(self) -> Callable[[], None]:
         """Install SIGTERM/SIGINT → checkpoint-and-stop handlers (only
@@ -506,6 +711,9 @@ class Trainer:
 
     def evaluate(self, pred_out: str | None = None) -> dict:
         cfg = self.cfg
+        obs = self.obs
+        obs.registry.reset()  # eval-scoped phase accounting
+        t0 = time.time()
         acc = AucAccumulator()
         pred_file = None
         out_path = pred_out if pred_out is not None else cfg.pred_out
@@ -535,9 +743,16 @@ class Trainer:
         try:
             # predict is collective too — keep hosts step-aligned
             block_idx = 0
-            for batch, _, _ in self._synced_batches(batches()):
-                arrays = self.step.put_batch(batch)
-                garr = self.step.predict(self.state, arrays)
+            it = iter(self._synced_batches(batches()))
+            while True:
+                try:
+                    with obs.phase("input_stall"):
+                        batch, _, _ = next(it)
+                except StopIteration:
+                    break
+                arrays = self.step.put_batch(batch)  # books 'h2d' inline
+                with obs.phase("dispatch"):
+                    garr = self.step.predict(self.state, arrays)
                 if self.num_hosts > 1:
                     # inverse of put_batch's host-local→global assembly:
                     # this host's rows of the sharded pctr
@@ -546,14 +761,15 @@ class Trainer:
                     garr = multihost_utils.global_array_to_host_local_array(
                         garr, self.mesh, self.step._bsharding.spec
                     )
-                pctr = np.asarray(jax.device_get(garr))
+                with obs.phase("device_block"):
+                    pctr = np.asarray(jax.device_get(garr))
                 acc.add(batch.labels, pctr, batch.weights)
                 if per_block and batch.weights.sum() > 0:
                     # reference artifact granularity: one
                     # pred_<rank>_<block>.txt per worker per block
                     # (lr_worker.cc:74-78); padding batches (multi-host
                     # step alignment) produce no file
-                    with open(
+                    with obs.phase("pred_write"), open(
                         os.path.join(
                             out_path, f"pred_{self.host}_{block_idx}.txt"
                         ),
@@ -564,44 +780,54 @@ class Trainer:
                                 f.write(f"{int(y)}\t{p:.6f}\n")
                     block_idx += 1
                 elif pred_file is not None:
-                    for y, p, w in zip(batch.labels, pctr, batch.weights):
-                        if w > 0:
-                            # "(label, pctr)" lines, lr_worker.cc:62-68.
-                            pred_file.write(f"{int(y)}\t{p:.6f}\n")
+                    with obs.phase("pred_write"):
+                        for y, p, w in zip(batch.labels, pctr, batch.weights):
+                            if w > 0:
+                                # "(label, pctr)" lines, lr_worker.cc:62-68.
+                                pred_file.write(f"{int(y)}\t{p:.6f}\n")
         finally:
             if pred_file is not None:
                 pred_file.close()
-        if self.num_hosts > 1:
-            # Rank-sum AUC is not decomposable over shard subsets.  The
-            # round-1 design allgathered every host's (label, pctr)
-            # pairs — O(test set) memory on EVERY host.  Now each host
-            # folds its pairs into fixed-size histograms (utils.metrics
-            # .HistAuc) and only those reduce across hosts: O(buckets)
-            # traffic/memory regardless of test-set size.  Logloss stays
-            # exact; AUC uses midrank ties on BOTH the single- and
-            # multi-host paths (AucAccumulator.compute is auc_midrank),
-            # so host count never changes the reported AUC beyond
-            # histogram quantization (< 1e-6 bucket width).
-            from xflow_tpu.parallel.multihost import allgather_exact
-            from xflow_tpu.utils.metrics import HistAuc
+        with obs.phase("metrics_compute"):
+            if self.num_hosts > 1:
+                # Rank-sum AUC is not decomposable over shard subsets.  The
+                # round-1 design allgathered every host's (label, pctr)
+                # pairs — O(test set) memory on EVERY host.  Now each host
+                # folds its pairs into fixed-size histograms (utils.metrics
+                # .HistAuc) and only those reduce across hosts: O(buckets)
+                # traffic/memory regardless of test-set size.  Logloss stays
+                # exact; AUC uses midrank ties on BOTH the single- and
+                # multi-host paths (AucAccumulator.compute is auc_midrank),
+                # so host count never changes the reported AUC beyond
+                # histogram quantization (< 1e-6 bucket width).
+                from xflow_tpu.parallel.multihost import allgather_exact
+                from xflow_tpu.utils.metrics import HistAuc
 
-            hist = HistAuc()
-            labels, pctr = acc.pairs()
-            hist.add(labels, pctr)
-            # bit-exact gather: the float64 histograms/sums must not be
-            # canonicalized to float32 (counts > 2^24 would drift)
-            summed = {
-                k: allgather_exact(v).sum(axis=0)
-                for k, v in hist.state().items()
-            }
-            hist = HistAuc.from_state(summed)
-            ll, auc = hist.compute()
-            n = hist.count()
-            pos = hist.num_pos()
-        else:
-            ll, auc = acc.compute()
-            n = acc.count()
-            pos = int(acc.pairs()[0].sum()) if n else 0
+                hist = HistAuc()
+                labels, pctr = acc.pairs()
+                hist.add(labels, pctr)
+                # bit-exact gather: the float64 histograms/sums must not be
+                # canonicalized to float32 (counts > 2^24 would drift)
+                summed = {
+                    k: allgather_exact(v).sum(axis=0)
+                    for k, v in hist.state().items()
+                }
+                hist = HistAuc.from_state(summed)
+                ll, auc = hist.compute()
+                n = hist.count()
+                pos = hist.num_pos()
+            else:
+                ll, auc = acc.compute()
+                n = acc.count()
+                pos = int(acc.pairs()[0].sum()) if n else 0
+        snap = obs.registry.snapshot(reset=True)
+        phases = snap.phase_seconds()
+        # parse/pack run on the eval loader's prefetch thread, h2d is
+        # inline here — same exclusive/overlapped split as train_epoch
+        overlapped = {
+            k: round(phases.pop(k), 6)
+            for k in ("parse", "pack") if k in phases
+        }
         result = {
             "epoch": self.epoch,
             "logloss": ll,
@@ -609,6 +835,9 @@ class Trainer:
             "examples": n,
             "tp": pos,
             "fp": n - pos,
+            "seconds": round(time.time() - t0, 3),
+            "phases": {k: round(v, 6) for k, v in phases.items()},
+            "overlapped": overlapped,
         }
         self._log(f"logloss: {ll:.6f}\tauc = {auc:.6f}\ttp = {pos} fp = {n - pos}")
         if self.metrics_logger is not None:

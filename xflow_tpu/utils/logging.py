@@ -5,6 +5,12 @@ logloss/AUC — lr_worker.cc:202,209, base.h:101-108).  Here every epoch
 and eval emits a JSON line with a monotonic timestamp so runs are
 machine-comparable; stdout keeps the human-readable reference-style
 lines.
+
+The file opens in APPEND mode (a preempted run resumed with --resume
+keeps one history), so every open stamps a ``run_start`` header row —
+run id, config digest, rank, host count — and ``python -m xflow_tpu.obs
+summarize`` splits runs on it instead of silently merging them.  The
+full record schema lives in obs/schema.py (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -15,17 +21,26 @@ from typing import Any, IO
 
 
 class MetricsLogger:
-    def __init__(self, path: str):
+    def __init__(self, path: str, run_header: dict[str, Any] | None = None):
         self._f: IO[str] = open(path, "a", buffering=1)
         self._t0 = time.time()
+        self.closed = False
+        if run_header is not None:
+            header = {"time_unix": round(self._t0, 3)}
+            header.update(run_header)
+            self.log("run_start", header)
 
     def log(self, kind: str, record: dict[str, Any]) -> None:
+        if self.closed:  # late log after a preemption/exception close
+            return
         row = {"t": round(time.time() - self._t0, 3), "kind": kind}
         row.update(record)
         self._f.write(json.dumps(row, sort_keys=True) + "\n")
 
     def close(self) -> None:
-        self._f.close()
+        if not self.closed:
+            self.closed = True
+            self._f.close()
 
     def __enter__(self) -> "MetricsLogger":
         return self
