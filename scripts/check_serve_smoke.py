@@ -1,0 +1,159 @@
+"""Serving smoke lint: train the toy pipeline, export an artifact,
+score through the MicroBatcher, and validate everything the serving
+tier promises (docs/SERVING.md):
+
+* the emitted serve-mode metrics JSONL rows (run_start / serve_load /
+  serve_stats) pass obs/schema.py strictly;
+* the engine compiled exactly once per warmed bucket and stayed there
+  under mixed-size traffic (the no-recompile guarantee);
+* batcher scores match direct engine predictions (coalescing changes
+  latency, never values);
+* the hot-table remap folds into the artifact (the toy model here
+  trains WITH a hot table so the remap path is exercised).
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python scripts/check_serve_smoke.py
+
+Wired into tier-1 like check_metrics_schema.py (tests/test_serve.py::
+test_check_serve_smoke_script), so a serving-schema drift or a
+recompile regression fails CI instead of surfacing as a latency cliff.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from tests.gen_data import generate_dataset
+    from xflow_tpu.config import Config
+    from xflow_tpu.obs.schema import SCHEMA, load_jsonl, validate_rows
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.batcher import MicroBatcher
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.trainer import Trainer
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    errors: list[str] = []
+    with tempfile.TemporaryDirectory() as root:
+        ds = generate_dataset(
+            os.path.join(root, "data"),
+            num_train_shards=2,
+            lines_per_shard=200,
+            num_fields=10,
+            vocab_per_field=8,
+            seed=7,
+            scale=3.0,
+        )
+        cfg = Config(
+            train_path=ds.train_prefix,
+            test_path=ds.test_prefix,
+            model="lr",
+            epochs=1,
+            batch_size=64,
+            table_size_log2=14,
+            max_nnz=24,
+            num_devices=1,
+            # hot table ON so the artifact carries (and the engine
+            # folds in) the frequency remap
+            hot_size_log2=6,
+            hot_nnz=8,
+            freq_sample_mib=1,
+        )
+        trainer = Trainer(cfg)
+        trainer.train()
+        artifact = export_artifact(trainer, os.path.join(root, "artifact"))
+        if not os.path.exists(os.path.join(artifact, "remap.npy")):
+            errors.append("hot-table artifact is missing remap.npy")
+
+        buckets = (8, 64)
+        engine = PredictEngine.load(artifact, buckets=buckets, warm=True)
+        if engine.compile_count != len(buckets):
+            errors.append(
+                f"warm() compiled {engine.compile_count} executables "
+                f"for {len(buckets)} buckets"
+            )
+
+        metrics = os.path.join(root, "serve.jsonl")
+        logger = MetricsLogger(metrics, run_header={
+            "run_id": f"{int(time.time() * 1000):x}-smoke",
+            "config_digest": engine.digest,
+            "rank": 0,
+            "num_hosts": 1,
+            "model": cfg.model,
+        })
+        logger.log("serve_load", {
+            "artifact": artifact,
+            "config_digest": engine.digest,
+            "model": cfg.model,
+            "buckets": list(engine.buckets),
+            "warm_seconds": round(engine.warm_seconds, 6),
+            "compiles": engine.compile_count,
+        })
+
+        rng = np.random.default_rng(0)
+        rows = [
+            rng.integers(0, cfg.table_size, size=int(rng.integers(1, 12)))
+            for _ in range(100)
+        ]
+        batcher = MicroBatcher(
+            engine, max_wait_ms=5.0, metrics_logger=logger
+        )
+        futs = [batcher.submit(r) for r in rows]
+        got = np.asarray([f.result() for f in futs])
+        stats = batcher.close()
+        logger.close()
+
+        direct = engine.predict(engine.featurize_raw(list(rows)))
+        if not np.allclose(got, direct, atol=1e-6):
+            errors.append("batcher scores diverge from direct engine predict")
+        if engine.compile_count != len(buckets):
+            errors.append(
+                f"mixed-size traffic grew compile_count to "
+                f"{engine.compile_count} (buckets: {len(buckets)}) — "
+                "the no-recompile guarantee is broken"
+            )
+        if stats["requests"] != len(rows):
+            errors.append(
+                f"serve_stats requests {stats['requests']} != {len(rows)}"
+            )
+        for field in ("queue_p99", "featurize_p99", "device_p99"):
+            if stats[field] <= 0.0:
+                errors.append(f"serve_stats {field} is not positive")
+
+        rows_jsonl = load_jsonl(metrics)
+        errors.extend(validate_rows(rows_jsonl))
+        kinds = {r.get("kind") for r in rows_jsonl}
+        for expected in ("run_start", "serve_load", "serve_stats"):
+            if expected not in kinds:
+                errors.append(f"serve pipeline emitted no {expected!r} row")
+        unknown = kinds - set(SCHEMA)
+        if unknown:
+            errors.append(f"kinds missing from SCHEMA: {sorted(unknown)}")
+        n = len(rows_jsonl)
+
+    for e in errors:
+        print(f"FAIL: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print(
+        f"OK: {n} serve metrics rows validated; "
+        f"{len(rows)} requests in {stats['batches']} coalesced batches; "
+        f"{engine.compile_count} compiles for {len(buckets)} buckets"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

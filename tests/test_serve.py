@@ -1,0 +1,442 @@
+"""Serving subsystem (ISSUE 2): artifact export/load, PredictEngine
+shape-bucketed compilation, micro-batching, hot swap, CLI, and the
+train → export → serve parity guarantee."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from xflow_tpu.config import Config
+from xflow_tpu.io.loader import ShardLoader
+from xflow_tpu.trainer import Trainer
+
+
+def _cfg(toy_dataset, **overrides):
+    base = dict(
+        train_path=toy_dataset.train_prefix,
+        test_path=toy_dataset.test_prefix,
+        model="lr",
+        epochs=2,
+        batch_size=64,
+        table_size_log2=14,
+        max_nnz=24,
+        num_devices=1,
+    )
+    base.update(overrides)
+    return Config(**base)
+
+
+def _raw_batches(trainer, path):
+    """Raw hash-key-space batches of the shard (no remap, no hot split)
+    — what an external caller would build (io/batch.py)."""
+    loader = ShardLoader(
+        path,
+        batch_size=trainer.cfg.batch_size,
+        max_nnz=trainer.cfg.max_nnz,
+        table_size=trainer.cfg.table_size,
+        parse_fn=trainer._parse_fn(),
+    )
+    return [b for b, _ in loader.iter_batches()]
+
+
+def _trainer_pctr(trainer, batch):
+    """The pre-engine reference path: prepare + put + compiled predict."""
+    return np.asarray(
+        jax.device_get(
+            trainer.step.predict(
+                trainer.state,
+                trainer.step.put_batch(trainer.prepare_batch(batch)),
+            )
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def lr_served(toy_dataset, tmp_path_factory):
+    """One trained lr model + its exported artifact, shared across
+    tests (export is read-only from then on)."""
+    from xflow_tpu.serve.artifact import export_artifact
+
+    trainer = Trainer(_cfg(toy_dataset))
+    trainer.train()
+    art = str(tmp_path_factory.mktemp("serve") / "artifact")
+    export_artifact(trainer, art)
+    return {"trainer": trainer, "artifact": art}
+
+
+def test_export_artifact_layout(lr_served):
+    from xflow_tpu.serve.artifact import load_manifest
+
+    art = lr_served["artifact"]
+    manifest = load_manifest(art)
+    assert manifest["model"] == "lr"
+    assert manifest["config_digest"] == lr_served["trainer"].cfg.digest()
+    assert "w.param" in manifest["arrays"]
+    files = os.listdir(art)
+    # params only: optimizer aux (FTRL n/z) never ships to serving
+    assert not any(".n.r" in f or ".z.r" in f for f in files)
+    assert any(f.startswith("w.param.r") for f in files)
+    assert "remap.npy" not in files  # no hot table on this model
+
+
+def test_engine_matches_trainer_and_eval_dump(lr_served, tmp_path):
+    """Train → export → PredictEngine parity: engine pctr matches the
+    trainer's compiled predict AND the evaluate() prediction dump to
+    1e-6."""
+    from xflow_tpu.serve.engine import PredictEngine
+
+    trainer = lr_served["trainer"]
+    engine = PredictEngine.load(
+        lr_served["artifact"], buckets=(8, 64), warm=True
+    )
+    shard = trainer.cfg.test_path + "-00000"
+    for batch in _raw_batches(trainer, shard):
+        np.testing.assert_allclose(
+            engine.predict(batch), _trainer_pctr(trainer, batch), atol=1e-6
+        )
+    # the evaluate() artifact (label\tpctr lines) as ground truth
+    pred = tmp_path / "pred.txt"
+    trainer.evaluate(pred_out=str(pred))
+    want = np.asarray(
+        [float(l.split("\t")[1]) for l in pred.read_text().splitlines()]
+    )
+    lines = open(shard).read().splitlines()
+    got = engine.score_text(lines)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_engine_parity_hot_table(toy_dataset, tmp_path):
+    """The hot-table remap folds into the artifact: an engine scoring
+    RAW hash-space batches matches the trainer bit-for-bit though the
+    table rows live in the permuted space."""
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.engine import PredictEngine
+
+    trainer = Trainer(_cfg(
+        toy_dataset, epochs=1,
+        hot_size_log2=6, hot_nnz=8, freq_sample_mib=1,
+    ))
+    trainer.train()
+    art = str(tmp_path / "hot_artifact")
+    export_artifact(trainer, art)
+    assert os.path.exists(os.path.join(art, "remap.npy"))
+    engine = PredictEngine.load(art, buckets=(64,), warm=True)
+    for batch in _raw_batches(trainer, trainer.cfg.test_path + "-00000"):
+        np.testing.assert_allclose(
+            engine.predict(batch), _trainer_pctr(trainer, batch), atol=1e-6
+        )
+
+
+def test_engine_needs_no_trainer_or_loader(lr_served, monkeypatch):
+    """Acceptance: PredictEngine scores with ZERO Trainer/ShardLoader
+    instantiation — both constructors are booby-trapped."""
+    from xflow_tpu.serve.engine import PredictEngine
+
+    def boom(*a, **kw):
+        raise AssertionError("serving must not instantiate this")
+
+    monkeypatch.setattr(Trainer, "__init__", boom)
+    monkeypatch.setattr(ShardLoader, "__init__", boom)
+    engine = PredictEngine.load(
+        lr_served["artifact"], buckets=(8,), warm=True
+    )
+    rows = [np.asarray([3, 99, 2048]), np.asarray([7])]
+    pctr = engine.predict(engine.featurize_raw(rows))
+    assert pctr.shape == (2,)
+    assert np.all((pctr > 0.0) & (pctr < 1.0))
+
+
+def test_one_compile_per_bucket(lr_served):
+    """Acceptance: exactly one compile per warmed bucket (the
+    compile-count hook), and NO traffic mix adds more — arbitrary
+    request sizes pad onto buckets, oversized batches chunk."""
+    from xflow_tpu.serve.engine import PredictEngine
+
+    engine = PredictEngine.load(
+        lr_served["artifact"], buckets=(1, 8, 64), warm=True
+    )
+    assert engine.buckets == (1, 8, 64)
+    assert engine.compile_count == 3
+    rng = np.random.default_rng(0)
+    table = engine.cfg.table_size
+    for n in (1, 2, 3, 7, 8, 9, 40, 64, 65, 200):
+        rows = [
+            rng.integers(0, table, size=int(rng.integers(1, 10)))
+            for _ in range(n)
+        ]
+        assert engine.predict(engine.featurize_raw(rows)).shape == (n,)
+    assert engine.compile_count == 3, "a request size triggered a recompile"
+
+
+def test_value_carrying_request_rejected_after_warm(lr_served):
+    """Compact-wire invariants are validated on EVERY serving batch —
+    warmup must not consume TrainStep's one-shot check and let a
+    value-carrying request silently score with vals=1."""
+    from xflow_tpu.serve.engine import PredictEngine
+
+    engine = PredictEngine.load(
+        lr_served["artifact"], buckets=(8,), warm=True
+    )
+    assert engine.step.compact_wire
+    bad = (np.asarray([3, 5]), None, np.asarray([0.5, 2.0]))
+    with pytest.raises(ValueError, match="compact wire"):
+        engine.predict(engine.featurize_raw([bad]))
+
+
+def test_engine_refuses_digest_mismatch(lr_served, tmp_path):
+    from xflow_tpu.serve.artifact import MANIFEST
+    from xflow_tpu.serve.engine import PredictEngine
+
+    trainer = lr_served["trainer"]
+    # caller expectation drifted from the exported config
+    with pytest.raises(ValueError, match="refusing"):
+        PredictEngine.load(
+            lr_served["artifact"],
+            config=trainer.cfg.replace(alpha=0.123),
+            warm=False,
+        )
+    # matching expectation loads fine
+    PredictEngine.load(
+        lr_served["artifact"], config=trainer.cfg, buckets=(8,), warm=False
+    )
+    # tampered artifact: stored digest no longer matches embedded config
+    import shutil
+
+    bad = tmp_path / "tampered"
+    shutil.copytree(lr_served["artifact"], bad)
+    mpath = bad / MANIFEST
+    manifest = json.loads(mpath.read_text())
+    manifest["config_digest"] = "deadbeef0000"
+    mpath.write_text(json.dumps(manifest))
+    with pytest.raises(ValueError, match="corrupt or tampered"):
+        PredictEngine.load(str(bad), warm=False)
+
+
+def test_engine_multidevice_mesh(lr_served):
+    """Artifact row-range shards assemble onto a different serving mesh
+    (1-chip export → 8-device engine); buckets round up to
+    mesh-divisible sizes and predictions are unchanged."""
+    from xflow_tpu.serve.engine import PredictEngine
+
+    e1 = PredictEngine.load(lr_served["artifact"], buckets=(8,), warm=False)
+    e8 = PredictEngine.load(
+        lr_served["artifact"], num_devices=8, buckets=(1, 8, 20), warm=False
+    )
+    assert e8.buckets == (8, 24)  # 1→8, 20→24 on the 8-device mesh
+    rows = [np.asarray([5, 17, 4000]), np.asarray([9, 1]), np.asarray([2])]
+    raw = e8.featurize_raw(rows)
+    np.testing.assert_allclose(
+        e8.predict(raw), e1.predict(raw), atol=1e-6
+    )
+
+
+def test_predict_batch_routes_through_buckets(toy_dataset):
+    """Satellite: XFlow.predict_batch no longer recompiles per batch
+    shape — distinct sizes share the engine's buckets, and the engine
+    tracks the LIVE trainer state (scores reflect further training)."""
+    from xflow_tpu.api import XFlow
+
+    xf = XFlow(
+        toy_dataset.train_prefix,
+        toy_dataset.test_prefix,
+        model="lr",
+        epochs=1,
+        batch_size=64,
+        table_size_log2=14,
+        max_nnz=24,
+        num_devices=1,
+    )
+    xf.train()
+    batches = _raw_batches(xf.trainer, xf.config.test_path + "-00000")
+    full = batches[0]
+    from xflow_tpu.serve.engine import _slice_rows
+
+    for n in (1, 3, 17, 64):
+        sub = _slice_rows(full, 0, n)
+        np.testing.assert_allclose(
+            xf.predict_batch(sub),
+            _trainer_pctr(xf.trainer, sub),
+            atol=1e-6,
+        )
+    engine = xf._engine
+    compiles = engine.compile_count
+    assert compiles <= len(engine.buckets)
+    # more training; predict_batch must see the evolved weights with
+    # no new compiles (same shapes/shardings through the AOT exes)
+    before = xf.predict_batch(full)
+    xf.trainer.train_epoch()
+    after = xf.predict_batch(full)
+    assert engine.compile_count == compiles
+    assert not np.allclose(before, after)
+    np.testing.assert_allclose(
+        after, _trainer_pctr(xf.trainer, full), atol=1e-6
+    )
+
+
+def test_microbatcher_coalesces_and_accounts(lr_served, tmp_path):
+    """Concurrent single-row submits coalesce into few device calls;
+    values match direct engine scoring; the serve_stats row carries
+    queue/featurize/device p50/p99 and passes the schema."""
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.serve.batcher import MicroBatcher
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    engine = PredictEngine.load(
+        lr_served["artifact"], buckets=(8, 64), warm=True
+    )
+    out = tmp_path / "serve.jsonl"
+    logger = MetricsLogger(out, run_header={
+        "run_id": "t", "config_digest": engine.digest,
+        "rank": 0, "num_hosts": 1,
+    })
+    rng = np.random.default_rng(1)
+    rows = [
+        rng.integers(0, engine.cfg.table_size, size=6) for _ in range(50)
+    ]
+    with MicroBatcher(
+        engine, max_wait_ms=20.0, metrics_logger=logger
+    ) as mb:
+        futs = [mb.submit(r) for r in rows]
+        got = np.asarray([f.result() for f in futs])
+    stats = mb.close()  # idempotent: same final row, not re-logged
+    assert mb.close() is stats
+    with pytest.raises(RuntimeError, match="closed"):
+        mb.submit(rows[0])
+    logger.close()
+    np.testing.assert_allclose(
+        got, engine.predict(engine.featurize_raw(rows)), atol=1e-6
+    )
+    rows_jsonl = load_jsonl(str(out))
+    assert validate_rows(rows_jsonl) == []
+    srows = [r for r in rows_jsonl if r["kind"] == "serve_stats"]
+    assert len(srows) == 1  # double close logs exactly one stats row
+    srow = srows[0]
+    assert srow["requests"] == 50
+    assert 0 < srow["batches"] < 50  # coalescing happened
+    for f in ("queue_p99", "featurize_p99", "device_p99"):
+        assert srow[f] > 0.0
+    assert srow["queue_p50"] <= srow["queue_p99"]
+    assert stats["requests"] == srow["requests"]
+
+
+def test_microbatcher_hot_swap(toy_dataset, tmp_path):
+    """Atomic mid-serve artifact swap: later requests score on the new
+    weights, and a swap to a DIFFERENT config digest is refused."""
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.batcher import MicroBatcher
+    from xflow_tpu.serve.engine import PredictEngine
+
+    trainer = Trainer(_cfg(toy_dataset, epochs=1))
+    trainer.train()
+    art_a = str(tmp_path / "a")
+    export_artifact(trainer, art_a)
+    trainer.train_epoch()  # evolve the weights
+    art_b = str(tmp_path / "b")
+    export_artifact(trainer, art_b)
+
+    ea = PredictEngine.load(art_a, buckets=(8,), warm=True)
+    eb = PredictEngine.load(art_b, buckets=(8,), warm=True)
+    # a row of keys the model actually trained on (arbitrary ids would
+    # hit untouched zero rows: pctr 0.5 on both engines)
+    first = _raw_batches(trainer, trainer.cfg.test_path + "-00000")[0]
+    row = first.keys[0][first.mask[0] > 0]
+    mb = MicroBatcher(ea, max_wait_ms=0.0)
+    try:
+        pa = mb.score(row)
+        mb.swap(eb)
+        pb = mb.score(row)
+        assert pa == pytest.approx(float(ea.predict(ea.featurize_raw([row]))[0]))
+        assert pb == pytest.approx(float(eb.predict(eb.featurize_raw([row]))[0]))
+        assert pa != pb
+        other = Trainer(_cfg(toy_dataset, epochs=1, alpha=0.9))
+        art_c = str(tmp_path / "c")
+        export_artifact(other, art_c)
+        ec = PredictEngine.load(art_c, buckets=(8,), warm=False)
+        with pytest.raises(ValueError, match="hot-swap refused"):
+            mb.swap(ec)
+        mb.swap(ec, force=True)  # explicit override works
+    finally:
+        stats = mb.close()
+    assert stats["swaps"] == 2
+
+
+def test_serve_cli_score_and_bench(lr_served, tmp_path, capsys):
+    from xflow_tpu.obs.__main__ import main as obs_main
+    from xflow_tpu.serve.__main__ import main as serve_main
+
+    shard = lr_served["trainer"].cfg.test_path + "-00000"
+    out = tmp_path / "scores.txt"
+    assert serve_main([
+        "score", lr_served["artifact"],
+        "--input", shard, "--out", str(out), "--buckets", "8,64",
+    ]) == 0
+    scores = [float(l) for l in out.read_text().splitlines()]
+    assert len(scores) == len(open(shard).read().splitlines())
+    assert all(0.0 < s < 1.0 for s in scores)
+
+    metrics = tmp_path / "bench.jsonl"
+    assert serve_main([
+        "bench", lr_served["artifact"],
+        "--requests", "32", "--concurrency", "4",
+        "--buckets", "8,64", "--max-wait-ms", "1",
+        "--metrics-out", str(metrics),
+    ]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    for f in (
+        "e2e_p50", "e2e_p99", "queue_p99", "featurize_p99",
+        "device_p99", "requests_per_sec",
+    ):
+        assert f in summary
+    assert summary["requests"] == 32
+    assert summary["compiles"] == 2
+    # satellite: obs validate covers serve-mode metrics files
+    assert obs_main(["validate", str(metrics)]) == 0
+    kinds = [
+        json.loads(l)["kind"] for l in metrics.read_text().splitlines()
+    ]
+    assert kinds == ["run_start", "serve_load", "serve_stats", "serve_bench"]
+
+
+def test_train_cli_export_artifact(toy_dataset, tmp_path):
+    """--export-artifact on the training CLI: the trained model lands
+    as a loadable serving artifact."""
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.train import main as train_main
+
+    art = tmp_path / "cli_artifact"
+    assert train_main([
+        "--train", toy_dataset.train_prefix,
+        "--test", toy_dataset.test_prefix,
+        "--model", "lr", "--epochs", "1", "--batch-size", "64",
+        "--table-size-log2", "14", "--max-nnz", "24",
+        "--num-devices", "1", "--skip-eval",
+        "--export-artifact", str(art),
+    ]) == 0
+    engine = PredictEngine.load(str(art), buckets=(8,), warm=True)
+    assert engine.compile_count == 1
+    assert engine.predict(engine._empty_batch(3)).shape == (3,)
+
+
+def test_check_serve_smoke_script():
+    """The CI lint (scripts/check_serve_smoke.py) passes — run as a
+    subprocess exactly as CI would (tier-1 wiring, like
+    check_metrics_schema.py)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "check_serve_smoke.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

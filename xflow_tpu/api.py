@@ -21,6 +21,7 @@ class XFlow:
     def __init__(self, train_path: str = "", test_path: str = "", **overrides: Any):
         self.config = Config(train_path=train_path, test_path=test_path, **overrides)
         self.trainer = Trainer(self.config)
+        self._engine = None
 
     def train(self) -> list[dict]:
         return self.trainer.train()
@@ -29,16 +30,31 @@ class XFlow:
         return self.trainer.evaluate(pred_out=pred_out)
 
     def predict_batch(self, batch) -> np.ndarray:
-        """pctr for one padded Batch built in the raw hash key space
-        (see io/batch.py).  When the model was trained with a hot table,
-        the trainer's frequency remap is applied here — the remap is
-        part of the model (io/freq.py)."""
-        import jax
+        """pctr for one Batch built in the raw hash key space (see
+        io/batch.py) — the hot-table remap is applied inside.  Routed
+        through a PredictEngine over the LIVE trainer state (weights
+        always current), so batch sizes snap onto the engine's shape
+        buckets: scoring a previously unseen batch size pads instead of
+        triggering a fresh XLA compile (serve/engine.py)."""
+        if self._engine is None:
+            from xflow_tpu.serve.engine import PredictEngine
 
-        arrays = self.trainer.step.put_batch(self.trainer.prepare_batch(batch))
-        return np.asarray(
-            jax.device_get(self.trainer.step.predict(self.trainer.state, arrays))
-        )
+            self._engine = PredictEngine(
+                self.config,
+                self.trainer.state,
+                remap=self.trainer.remap,
+                mesh=self.trainer.mesh,
+            )
+        self._engine.update_state(self.trainer.state)
+        return self._engine.predict(batch)
+
+    def export_artifact(self, directory: str) -> str:
+        """Freeze the current weights into a serving artifact
+        (serve/artifact.py) loadable by PredictEngine with no Trainer,
+        loader, or optimizer state."""
+        from xflow_tpu.serve.artifact import export_artifact
+
+        return export_artifact(self.trainer, directory)
 
     def save(self) -> str | None:
         return self.trainer.save()

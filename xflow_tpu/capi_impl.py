@@ -8,9 +8,15 @@ surface is real: ``libxflow_tpu.so`` embeds CPython and drives these
 functions; C/C++ programs get create/train/evaluate/predict without a
 Python process.
 
+The predict path needs NO full Trainer: ``engine_create`` loads a
+serving artifact (serve/artifact.py) into a PredictEngine — frozen
+params + remap only, shape-bucketed compilation — so a C scoring
+process never builds a loader, optimizer state, or training step.
+``export_artifact`` is the training-side handoff.
+
 Kept deliberately tiny: the C side only imports this module and calls
-these three functions, so the ABI never needs to know about Config or
-Trainer internals.
+these functions, so the ABI never needs to know about Config, Trainer,
+or engine internals.
 """
 
 from __future__ import annotations
@@ -33,3 +39,18 @@ def train(xf: XFlow) -> int:
 def evaluate(xf: XFlow) -> tuple[float, float]:
     res = xf.evaluate()
     return float(res["logloss"]), float(res["auc"])
+
+
+def export_artifact(xf: XFlow, directory: str) -> str:
+    return xf.export_artifact(directory)
+
+
+def engine_create(artifact_dir: str, num_devices: int = 1):
+    from xflow_tpu.serve.engine import PredictEngine
+
+    return PredictEngine.load(artifact_dir, num_devices=num_devices)
+
+
+def engine_score_line(engine, line: str) -> float:
+    """pctr for one libffm-format line (label field ignored)."""
+    return float(engine.score_text([line])[0])

@@ -337,6 +337,16 @@ class Config:
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
 
+    def digest(self) -> str:
+        """12-hex-char sha256 of the config JSON — the run/artifact
+        identity stamped into metrics ``run_start`` headers
+        (trainer._run_header) and serving-artifact manifests
+        (serve/artifact.py); PredictEngine refuses artifacts whose
+        digest doesn't match an expected config."""
+        import hashlib
+
+        return hashlib.sha256(self.to_json().encode()).hexdigest()[:12]
+
     @classmethod
     def from_json(cls, text: str) -> "Config":
         raw: dict[str, Any] = json.loads(text)

@@ -150,6 +150,76 @@ def make_batch(
     )
 
 
+def remap_batch(
+    batch: Batch,
+    remap: np.ndarray | None,
+    hot_size: int,
+    hot_nnz: int,
+) -> Batch:
+    """Bring an externally built Batch (raw hash-space keys) into a
+    hot-table model's key space: apply the frequency remap (io/freq.py)
+    and re-steer the hot/cold sections.  Loader-produced batches are
+    already remapped at parse/pack time; this is for user-supplied
+    batches (api.XFlow.predict_batch, serve.PredictEngine).  The ONE
+    copy of the remap-and-steer rule, shared by Trainer.prepare_batch
+    and the serving engine so the two paths cannot drift.
+
+    No-op when ``remap`` is None (model trained without a hot table).
+    """
+    if remap is None:
+        return batch
+    # merge any existing hot section back, remap, then re-steer (a
+    # remapped key may cross the hot/cold boundary in either direction);
+    # pad by hot_nnz columns so the post-split cold capacity equals the
+    # full incoming width — even if every incoming entry lands cold,
+    # nothing is truncated on re-steer
+    b = batch.batch_size
+    pad_i = np.zeros((b, hot_nnz), np.int32)
+    pad_f = np.zeros((b, hot_nnz), np.float32)
+    keys = np.concatenate([batch.hot_keys, batch.keys, pad_i], axis=1)
+    slots = np.concatenate([batch.hot_slots, batch.slots, pad_i], axis=1)
+    vals = np.concatenate([batch.hot_vals, batch.vals, pad_f], axis=1)
+    mask = np.concatenate([batch.hot_mask, batch.mask, pad_f], axis=1)
+    keys = np.where(mask > 0, remap[keys], 0).astype(np.int32)
+    return make_batch(
+        keys, slots, vals, mask, batch.labels, batch.weights,
+        hot_size, hot_nnz,
+    )
+
+
+def pad_batch_rows(batch: Batch, to: int) -> Batch:
+    """Extend a Batch to ``to`` rows with zero-weight padding examples
+    (mask/weights 0 — no-ops through predict and training alike).  Used
+    by the serving engine to snap request batches onto its fixed
+    compile-shape buckets."""
+    extra = to - batch.batch_size
+    if extra < 0:
+        raise ValueError(
+            f"pad_batch_rows: batch has {batch.batch_size} rows, "
+            f"cannot shrink to {to}"
+        )
+    if extra == 0:
+        return batch
+
+    def pad(a: np.ndarray) -> np.ndarray:
+        return np.concatenate(
+            [a, np.zeros((extra,) + a.shape[1:], a.dtype)]
+        )
+
+    return Batch(
+        keys=pad(batch.keys),
+        slots=pad(batch.slots),
+        vals=pad(batch.vals),
+        mask=pad(batch.mask),
+        labels=pad(batch.labels),
+        weights=pad(batch.weights),
+        hot_keys=pad(batch.hot_keys),
+        hot_slots=pad(batch.hot_slots),
+        hot_vals=pad(batch.hot_vals),
+        hot_mask=pad(batch.hot_mask),
+    )
+
+
 def pack_batch(
     block: ParsedBlock,
     start: int,

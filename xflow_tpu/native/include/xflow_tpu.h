@@ -30,6 +30,23 @@ int XFEvaluate(XFHandle h, double* logloss, double* auc);
 void XFDestroy(XFHandle h);
 const char* XFLastError(void);
 
+/* -- serving (xflow_tpu/serve; docs/SERVING.md) --------------------------
+ *
+ * The lean scoring path: export a trained model to an artifact dir,
+ * then score through a PredictEngine — no Trainer, loader, or
+ * optimizer state in the serving process, and batch shapes snap onto
+ * precompiled buckets so concurrent scoring never recompiles.
+ *
+ *   XFExportArtifact(h, "artifacts/v1");       // training side
+ *   XFHandle e = XFEngineCreate("artifacts/v1");
+ *   double pctr;
+ *   XFEngineScore(e, "0\t1:42:1 2:77:1", &pctr);
+ *   XFDestroy(e);                              // engines share XFDestroy
+ */
+int XFExportArtifact(XFHandle h, const char* directory);
+XFHandle XFEngineCreate(const char* artifact_dir);
+int XFEngineScore(XFHandle engine, const char* libffm_line, double* pctr);
+
 #ifdef __cplusplus
 }
 #endif

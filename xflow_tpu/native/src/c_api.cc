@@ -161,4 +161,67 @@ void XFDestroy(XFHandle h) {
   Py_DECREF(static_cast<PyObject*>(h));
 }
 
+// -- serving (xflow_tpu/serve) -------------------------------------------
+
+int XFExportArtifact(XFHandle h, const char* directory) {
+  if (h == nullptr || directory == nullptr || Py_IsInitialized() == 0)
+    return -1;
+  GilGuard gil;
+  PyObject* args =
+      Py_BuildValue("(Os)", static_cast<PyObject*>(h), directory);
+  if (args == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* out = call_impl("export_artifact", args);
+  Py_DECREF(args);
+  if (out == nullptr) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+XFHandle XFEngineCreate(const char* artifact_dir) {
+  if (artifact_dir == nullptr) {
+    g_last_error = "artifact_dir is NULL";
+    return nullptr;
+  }
+  if (!ensure_python()) {
+    g_last_error = "failed to initialize embedded python";
+    return nullptr;
+  }
+  GilGuard gil;
+  PyObject* args = Py_BuildValue("(s)", artifact_dir);
+  if (args == nullptr) {
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* eng = call_impl("engine_create", args);
+  Py_DECREF(args);
+  return static_cast<XFHandle>(eng);  // new reference owned by the handle
+}
+
+int XFEngineScore(XFHandle engine, const char* libffm_line, double* pctr) {
+  if (engine == nullptr || libffm_line == nullptr ||
+      Py_IsInitialized() == 0)
+    return -1;
+  GilGuard gil;
+  PyObject* args =
+      Py_BuildValue("(Os)", static_cast<PyObject*>(engine), libffm_line);
+  if (args == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* out = call_impl("engine_score_line", args);
+  Py_DECREF(args);
+  if (out == nullptr) return -1;
+  double p = PyFloat_AsDouble(out);
+  Py_DECREF(out);
+  if (p == -1.0 && PyErr_Occurred() != nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  if (pctr != nullptr) *pctr = p;
+  return 0;
+}
+
 }  // extern "C"

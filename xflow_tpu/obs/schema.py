@@ -88,6 +88,55 @@ SCHEMA: dict[str, dict[str, Any]] = {
         "epoch": int,
         "devices": list,
     },
+    # -- serving (serve/; docs/SERVING.md) ---------------------------------
+    # one per PredictEngine artifact load: bucket geometry + warmup cost
+    "serve_load": {
+        "t": (int, float),
+        "kind": str,
+        "artifact": str,
+        "config_digest": str,
+        "model": str,
+        "buckets": list,
+        "warm_seconds": (int, float),
+        "compiles": int,
+    },
+    # one per MicroBatcher flush/close: per-request latency percentiles
+    # (queue = enqueue→dequeue, featurize = request→Batch assembly,
+    # device = h2d + execute + fetch) over the window since the last
+    # emission, plus coalescing effectiveness (requests/batches)
+    "serve_stats": {
+        "t": (int, float),
+        "kind": str,
+        "requests": int,
+        "batches": int,
+        "swaps": int,
+        "batch_fill_mean": (int, float),
+        "queue_p50": (int, float),
+        "queue_p99": (int, float),
+        "featurize_p50": (int, float),
+        "featurize_p99": (int, float),
+        "device_p50": (int, float),
+        "device_p99": (int, float),
+    },
+    # one per `python -m xflow_tpu.serve bench` run: end-to-end serving
+    # latency/throughput under concurrent load
+    "serve_bench": {
+        "t": (int, float),
+        "kind": str,
+        "requests": int,
+        "concurrency": int,
+        "seconds": (int, float),
+        "requests_per_sec": (int, float),
+        "e2e_p50": (int, float),
+        "e2e_p99": (int, float),
+        "queue_p50": (int, float),
+        "queue_p99": (int, float),
+        "featurize_p50": (int, float),
+        "featurize_p99": (int, float),
+        "device_p50": (int, float),
+        "device_p99": (int, float),
+        "compiles": int,
+    },
 }
 
 

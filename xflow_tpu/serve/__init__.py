@@ -1,0 +1,37 @@
+"""Serving subsystem (ISSUE 2): exportable inference artifacts and a
+low-latency scoring tier that needs no Trainer, no loader, and no
+optimizer state.
+
+Production ads stacks separate training from online scoring (PAPERS.md:
+Distributed Hierarchical GPU Parameter Server, arxiv 2003.05622;
+Scalable ML Training Infra for Online Ads at Google, arxiv 2501.10546).
+Here that split is three layers:
+
+* ``artifact`` — ``export_artifact(trainer, dir)`` freezes inference
+  weights (params only — FTRL n/z stay behind), the hot-table remap,
+  and a digest-stamped manifest, in the checkpoint row-range shard
+  format (utils/checkpoint.py) so multi-host exports need no gather;
+* ``engine`` — ``PredictEngine``: loads an artifact (or wraps a live
+  trainer state), compiles the predict step once per fixed batch-size
+  bucket (AOT — concurrent traffic never triggers fresh XLA compiles),
+  and scores padded request batches;
+* ``batcher`` — ``MicroBatcher``: coalesces concurrent single-row
+  requests into one bucketed device call under a max-wait deadline,
+  with atomic hot-swap of a newer artifact mid-serve and per-request
+  queue/featurize/device latency histograms (obs registry; JSONL kinds
+  in obs/schema.py).
+
+CLI: ``python -m xflow_tpu.serve bench|score`` (docs/SERVING.md).
+"""
+
+from xflow_tpu.serve.artifact import export_artifact, load_manifest
+from xflow_tpu.serve.batcher import MicroBatcher
+from xflow_tpu.serve.engine import DEFAULT_BUCKETS, PredictEngine
+
+__all__ = [
+    "export_artifact",
+    "load_manifest",
+    "PredictEngine",
+    "MicroBatcher",
+    "DEFAULT_BUCKETS",
+]

@@ -1,0 +1,209 @@
+"""CLI: ``python -m xflow_tpu.serve <bench|score> ARTIFACT ...``
+
+    score  ARTIFACT --input FILE      pctr per libffm line (stdout/--out)
+    bench  ARTIFACT [--requests N]    concurrent single-row load through
+                                      the MicroBatcher; prints a JSON
+                                      summary with queue/featurize/
+                                      device/e2e p50+p99 and logs
+                                      serve_load/serve_stats/serve_bench
+                                      JSONL rows (--metrics-out) that
+                                      ``python -m xflow_tpu.obs
+                                      validate`` checks like any other
+                                      metrics file
+
+Serving docs: docs/SERVING.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _buckets(text: str | None) -> tuple[int, ...] | None:
+    if not text:
+        return None
+    return tuple(int(b) for b in text.split(","))
+
+
+def _percentile(vals: list[float], p: float) -> float:
+    # one percentile definition repo-wide: obs.registry.Histogram
+    from xflow_tpu.obs.registry import Histogram
+
+    h = Histogram(capacity=max(len(vals), 1))
+    for v in vals:
+        h.observe(v)
+    return round(h.percentile(p), 6)
+
+
+def cmd_score(args) -> int:
+    from xflow_tpu.serve.engine import PredictEngine
+
+    engine = PredictEngine.load(
+        args.artifact,
+        num_devices=args.num_devices,
+        buckets=_buckets(args.buckets),
+        warm=not args.no_warm,
+    )
+    src = open(args.input) if args.input else sys.stdin
+    try:
+        lines = [l for l in src.read().splitlines() if l.strip()]
+    finally:
+        if args.input:
+            src.close()
+    pctr = engine.score_text(lines)
+    out = open(args.out, "w") if args.out else sys.stdout
+    try:
+        for p in pctr:
+            out.write(f"{p:.6f}\n")
+    finally:
+        if args.out:
+            out.close()
+    return 0
+
+
+def cmd_bench(args) -> int:
+    from xflow_tpu.obs.schema import validate_rows
+    from xflow_tpu.serve.batcher import MicroBatcher
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    engine = PredictEngine.load(
+        args.artifact,
+        num_devices=args.num_devices,
+        buckets=_buckets(args.buckets),
+        warm=True,
+    )
+    cfg = engine.cfg
+    logger = None
+    if args.metrics_out:
+        logger = MetricsLogger(
+            args.metrics_out,
+            run_header={
+                "run_id": f"{int(time.time() * 1000):x}-bench",
+                "config_digest": engine.digest,
+                "rank": 0,
+                "num_hosts": 1,
+                "model": cfg.model,
+            },
+        )
+        logger.log("serve_load", {
+            "artifact": args.artifact,
+            "config_digest": engine.digest,
+            "model": cfg.model,
+            "buckets": list(engine.buckets),
+            "warm_seconds": round(engine.warm_seconds, 6),
+            "compiles": engine.compile_count,
+        })
+    batcher = MicroBatcher(
+        engine, max_wait_ms=args.max_wait_ms, metrics_logger=logger
+    )
+    rng = np.random.default_rng(args.seed)
+    nnz = min(args.nnz, cfg.max_nnz)
+    rows = [
+        (
+            rng.integers(0, cfg.table_size, size=nnz).astype(np.int64),
+            np.arange(nnz, dtype=np.int32) % max(cfg.max_fields, 1),
+            None,
+        )
+        for _ in range(args.requests)
+    ]
+    e2e: list[float] = []
+    e2e_lock = threading.Lock()
+
+    def worker(my_rows) -> None:
+        for row in my_rows:
+            t0 = time.perf_counter()
+            fut = batcher.submit(*row)
+            fut.result()
+            dt = time.perf_counter() - t0
+            with e2e_lock:
+                e2e.append(dt)
+
+    threads = [
+        threading.Thread(target=worker, args=(rows[i :: args.concurrency],))
+        for i in range(args.concurrency)
+    ]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    seconds = time.perf_counter() - t_start
+    stats = batcher.close()
+    summary = {
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "seconds": round(seconds, 6),
+        "requests_per_sec": round(args.requests / max(seconds, 1e-9), 1),
+        "e2e_p50": _percentile(e2e, 50),
+        "e2e_p99": _percentile(e2e, 99),
+        "queue_p50": stats["queue_p50"],
+        "queue_p99": stats["queue_p99"],
+        "featurize_p50": stats["featurize_p50"],
+        "featurize_p99": stats["featurize_p99"],
+        "device_p50": stats["device_p50"],
+        "device_p99": stats["device_p99"],
+        "compiles": engine.compile_count,
+    }
+    if logger is not None:
+        logger.log("serve_bench", summary)
+        logger.close()
+        from xflow_tpu.obs.schema import load_jsonl
+
+        errors = validate_rows(load_jsonl(args.metrics_out))
+        if errors:
+            for e in errors:
+                print(f"schema violation: {e}", file=sys.stderr)
+            return 1
+    print(json.dumps(
+        dict(summary, buckets=list(engine.buckets),
+             batch_fill_mean=stats["batch_fill_mean"]),
+        sort_keys=True,
+    ))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m xflow_tpu.serve",
+        description="serving toolchain (docs/SERVING.md)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("artifact", help="artifact dir (serve/artifact.py)")
+        sp.add_argument("--num-devices", type=int, default=1)
+        sp.add_argument(
+            "--buckets", default="",
+            help="comma-separated batch-size buckets (default 1,8,64,512)",
+        )
+
+    ps = sub.add_parser("score", help="pctr per libffm input line")
+    common(ps)
+    ps.add_argument("--input", default="", help="libffm file (default stdin)")
+    ps.add_argument("--out", default="", help="output file (default stdout)")
+    ps.add_argument("--no-warm", action="store_true")
+
+    pb = sub.add_parser("bench", help="concurrent serving latency bench")
+    common(pb)
+    pb.add_argument("--requests", type=int, default=256)
+    pb.add_argument("--concurrency", type=int, default=8)
+    pb.add_argument("--max-wait-ms", type=float, default=2.0)
+    pb.add_argument("--nnz", type=int, default=16, help="features/request")
+    pb.add_argument("--seed", type=int, default=0)
+    pb.add_argument("--metrics-out", default="")
+    args = p.parse_args(argv)
+
+    if args.cmd == "score":
+        return cmd_score(args)
+    return cmd_bench(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""Exportable inference artifacts — the training→serving handoff.
+
+An artifact is a directory holding everything prediction needs and
+NOTHING training needs:
+
+* ``<table>.param.r<start>-<stop>.npy`` — frozen weight-table rows in
+  the checkpoint row-range shard format (utils/checkpoint.py): each
+  process writes only the rows its devices own, and a later load can
+  assemble ANY target sharding from whatever ranges exist via mmap —
+  an artifact exported on a pod restores onto a 1-chip scoring tier.
+  Optimizer aux arrays (FTRL n/z) are deliberately absent: they are
+  ~2/3 of a checkpoint's bytes and serve no inference purpose.
+* ``dense.<name>.npy`` — replicated dense params (MLP models).
+* ``remap.npy`` — the hot-table frequency remap (io/freq.py), present
+  iff the model was trained with a hot table.  The remap is part of
+  the model: raw hash-space keys are addressed through it, so it ships
+  inside the artifact instead of living beside checkpoints.
+* ``manifest.json`` — format version, model name, the FULL training
+  config JSON plus its digest (config.Config.digest), array metadata,
+  and the train-step counter.  PredictEngine refuses artifacts whose
+  stored digest doesn't match the embedded config (tampering/drift)
+  or a caller-expected config (serving the wrong model).
+
+Multi-host protocol: identical to save_checkpoint — all processes
+write into a temp dir, every stage votes through ``all_ok`` (a barrier
+that propagates local failures instead of deadlocking), process 0
+writes the manifest and atomically renames.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any
+
+import numpy as np
+
+import jax
+
+from xflow_tpu.utils.checkpoint import all_ok, iter_owned_shards
+
+MANIFEST = "manifest.json"
+FORMAT = 1
+REMAP_FILE = "remap.npy"
+
+
+def export_artifact(trainer, directory: str) -> str:
+    """Freeze ``trainer``'s model into a serving artifact at
+    ``directory`` (replaced atomically if it exists); returns the path.
+
+    Multi-host: COLLECTIVE — all processes call together; each writes
+    its own table row ranges (module docstring)."""
+    state = trainer.state
+    cfg = trainer.cfg
+    step = int(jax.device_get(state["step"]))
+    proc = jax.process_index()
+    parent = os.path.dirname(os.path.abspath(directory))
+    tmp = os.path.join(
+        parent, f".tmp-artifact-{os.path.basename(directory)}"
+    )
+    err: BaseException | None = None
+    try:
+        if proc == 0:
+            os.makedirs(parent, exist_ok=True)
+            if os.path.exists(tmp):  # leftover from a crashed attempt
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+    except BaseException as e:
+        err = e
+    if not all_ok(err is None):
+        if err is not None:
+            raise err
+        raise RuntimeError("artifact mkdir failed on process 0")
+    try:
+        arrays_meta: dict[str, Any] = {}
+        for tname in sorted(state["tables"]):
+            arr = state["tables"][tname]["param"]
+            key = f"{tname}.param"
+            arrays_meta[key] = {
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+            }
+            for start, stop, host_data in iter_owned_shards(arr):
+                np.save(
+                    os.path.join(
+                        tmp, f"{key}.r{start:012d}-{stop:012d}.npy"
+                    ),
+                    host_data,
+                )
+        if proc == 0:
+            for dname in sorted(state.get("dense", {})):
+                np.save(
+                    os.path.join(tmp, f"dense.{dname}.npy"),
+                    np.asarray(jax.device_get(state["dense"][dname])),
+                )
+            if trainer.remap is not None:
+                np.save(os.path.join(tmp, REMAP_FILE), trainer.remap)
+    except BaseException as e:
+        err = e
+    if not all_ok(err is None):
+        if proc == 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+        if err is not None:
+            raise err
+        raise RuntimeError("artifact export failed on another process")
+    try:
+        if proc == 0:
+            manifest = {
+                "format": FORMAT,
+                "model": cfg.model,
+                "step": step,
+                "config": cfg.to_json(),
+                "config_digest": cfg.digest(),
+                "arrays": arrays_meta,
+                "dense": sorted(state.get("dense", {})),
+                "remap": trainer.remap is not None,
+                "created_unix": round(time.time(), 3),
+            }
+            with open(os.path.join(tmp, MANIFEST), "w") as f:
+                json.dump(manifest, f, indent=2)
+            # never leave the target path without a loadable artifact:
+            # move the old one ASIDE first, rename the new one in, THEN
+            # delete — a crash in between still leaves either the old
+            # or the new artifact at (or recoverable next to) the path
+            old = None
+            if os.path.exists(directory):
+                old = directory + ".old"
+                if os.path.exists(old):
+                    shutil.rmtree(old)
+                os.rename(directory, old)
+            os.rename(tmp, directory)
+            if old is not None:
+                shutil.rmtree(old)
+    except BaseException as e:
+        err = e
+    if not all_ok(err is None):
+        if proc == 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+        if err is not None:
+            raise err
+        raise RuntimeError("artifact finalize failed on process 0")
+    return directory
+
+
+def load_manifest(directory: str) -> dict:
+    """Parse + integrity-check an artifact manifest.  Raises ValueError
+    on a missing/foreign/future-format manifest or when the stored
+    config digest doesn't match the embedded config (tampering or a
+    digest-scheme drift — either way the artifact identity is void)."""
+    from xflow_tpu.config import Config
+
+    path = os.path.join(directory, MANIFEST)
+    if not os.path.exists(path):
+        raise ValueError(f"{directory}: no artifact manifest ({MANIFEST})")
+    with open(path) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != FORMAT:
+        raise ValueError(
+            f"{directory}: unsupported artifact format "
+            f"{manifest.get('format')!r} (expected {FORMAT})"
+        )
+    cfg = Config.from_json(manifest["config"])
+    if cfg.digest() != manifest.get("config_digest"):
+        raise ValueError(
+            f"{directory}: manifest config_digest "
+            f"{manifest.get('config_digest')!r} does not match the "
+            f"embedded config ({cfg.digest()}) — artifact corrupt or "
+            "tampered"
+        )
+    return manifest

@@ -1,0 +1,218 @@
+"""Micro-batching request queue — single-row scoring at device-batch
+efficiency.
+
+Online CTR traffic arrives as independent single-row requests, but the
+device wants bucketed batches (serve/engine.py).  The MicroBatcher
+bridges them: requests enqueue with a timestamp; a worker thread
+coalesces everything that arrives within a ``max_wait_ms`` deadline
+(capped at the engine's largest bucket) into ONE featurize + ONE
+bucketed device call, then resolves each request's Future.  Tail
+latency is bounded by ``max_wait_ms`` + one device call; throughput
+approaches the bucketed batch rate as concurrency rises.
+
+Latency accounting (ISSUE 2): per-request queue (enqueue→dequeue),
+featurize (request→Batch assembly), and device (h2d+execute+fetch)
+seconds land in obs registry histograms; ``emit_stats``/``close``
+flush a ``serve_stats`` JSONL row (obs/schema.py) with p50/p99 per
+phase and the coalescing ratio.
+
+Hot swap: ``swap(new_engine)`` atomically replaces the engine between
+batches — the in-flight batch finishes on the old one, the next batch
+scores on the new one; zero dropped or mixed requests.  Digest-guarded:
+a replacement exported from a different config is refused unless
+``force=True`` (rolling out a new model GEOMETRY is a redeploy, not a
+hot swap)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any
+
+import numpy as np
+
+from xflow_tpu.obs.registry import MetricsRegistry
+
+_STOP = object()
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        engine,
+        max_wait_ms: float = 2.0,
+        max_batch: int | None = None,
+        registry: MetricsRegistry | None = None,
+        metrics_logger=None,
+    ):
+        if max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        self._engine = engine
+        self._max_wait = max_wait_ms / 1000.0
+        self._max_batch = (
+            max_batch if max_batch is not None else engine.buckets[-1]
+        )
+        if self._max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        # a coalesced batch must fit the engine's largest bucket
+        # (featurize pads onto ONE bucket, it never chunks)
+        self._max_batch = min(self._max_batch, engine.buckets[-1])
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.metrics_logger = metrics_logger
+        self._q: queue.Queue = queue.Queue()
+        self._swap_lock = threading.Lock()
+        self._submit_lock = threading.Lock()
+        self._closed = False
+        self._final_stats: dict | None = None
+        self._thread = threading.Thread(
+            target=self._loop, name="xflow-serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def engine(self):
+        return self._engine
+
+    # -- request side ------------------------------------------------------
+
+    def submit(self, keys, slots=None, vals=None) -> Future:
+        """Enqueue one scoring request (raw hash-space features; vals
+        default to 1.0 — the hash-mode convention) and return a Future
+        resolving to its pctr."""
+        # the closed-check + put is atomic w.r.t. close(), so every
+        # accepted request is enqueued BEFORE the _STOP sentinel and is
+        # guaranteed to be scored — no Future can sit behind _STOP
+        # forever
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            fut: Future = Future()
+            self._q.put(((keys, slots, vals), fut, time.perf_counter()))
+        return fut
+
+    def score(self, keys, slots=None, vals=None) -> float:
+        return float(self.submit(keys, slots, vals).result())
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def swap(self, engine, force: bool = False) -> None:
+        """Atomically replace the serving engine (newer artifact).  The
+        in-flight batch completes on the old engine; every later batch
+        scores on the new one."""
+        if not force and engine.digest != self._engine.digest:
+            raise ValueError(
+                f"hot-swap refused: new engine digest {engine.digest} "
+                f"!= serving digest {self._engine.digest} (different "
+                "config/geometry — pass force=True only if you mean it)"
+            )
+        with self._swap_lock:
+            self._engine = engine
+        self.registry.counter_add("serve.swaps")
+
+    def emit_stats(self) -> dict:
+        """Snapshot-and-reset the latency window into a ``serve_stats``
+        record (logged to the metrics JSONL when a logger is attached);
+        returns the record."""
+        snap = self.registry.snapshot(reset=True)
+
+        def pct(name: str, p: str) -> float:
+            return round(snap.hists.get(name, {}).get(p, 0.0), 6)
+
+        row = {
+            "requests": int(snap.counters.get("serve.requests", 0)),
+            "batches": int(snap.counters.get("serve.batches", 0)),
+            "swaps": int(snap.counters.get("serve.swaps", 0)),
+            "batch_fill_mean": round(
+                snap.hists.get("serve.batch_size", {}).get("mean", 0.0), 3
+            ),
+            "queue_p50": pct("serve.queue_seconds", "p50"),
+            "queue_p99": pct("serve.queue_seconds", "p99"),
+            "featurize_p50": pct("serve.featurize_seconds", "p50"),
+            "featurize_p99": pct("serve.featurize_seconds", "p99"),
+            "device_p50": pct("serve.device_seconds", "p50"),
+            "device_p99": pct("serve.device_seconds", "p99"),
+        }
+        if self.metrics_logger is not None:
+            self.metrics_logger.log("serve_stats", row)
+        return row
+
+    def close(self) -> dict:
+        """Drain the queue, stop the worker, flush ONE final
+        ``serve_stats`` row; returns it.  Idempotent: later calls
+        return the same row without logging again."""
+        with self._submit_lock:
+            first = not self._closed
+            if first:
+                self._closed = True
+                self._q.put(_STOP)
+        if first:
+            self._thread.join()
+            self._final_stats = self.emit_stats()
+        assert self._final_stats is not None
+        return self._final_stats
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- worker ------------------------------------------------------------
+
+    def _loop(self) -> None:
+        stopping = False
+        while not stopping:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            reqs = [item]
+            deadline = time.perf_counter() + self._max_wait
+            while len(reqs) < self._max_batch:
+                timeout = deadline - time.perf_counter()
+                if timeout <= 0:
+                    # deadline passed: take whatever is already queued,
+                    # but don't wait for more
+                    timeout = 0.0
+                try:
+                    nxt = self._q.get(timeout=timeout) if timeout else (
+                        self._q.get_nowait()
+                    )
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stopping = True
+                    break
+                reqs.append(nxt)
+            self._run_batch(reqs)
+
+    def _run_batch(self, reqs: list) -> None:
+        with self._swap_lock:
+            engine = self._engine
+        t_deq = time.perf_counter()
+        reg = self.registry
+        for _, _, t_enq in reqs:
+            reg.observe("serve.queue_seconds", t_deq - t_enq)
+        try:
+            t0 = time.perf_counter()
+            batch = engine.featurize([row for row, _, _ in reqs])
+            t1 = time.perf_counter()
+            pctr = engine.predict_prepared(batch)[: len(reqs)]
+            t2 = time.perf_counter()
+        except BaseException as e:  # resolve, never wedge the callers
+            for _, fut, _ in reqs:
+                fut.set_exception(e)
+            return
+        # featurize/device are shared per batch: every coalesced request
+        # EXPERIENCED the whole batch's featurize+device wall, so each
+        # observes the full value — that is its latency, not an
+        # amortized share.
+        feat, dev = t1 - t0, t2 - t1
+        for i, (_, fut, _) in enumerate(reqs):
+            reg.observe("serve.featurize_seconds", feat)
+            reg.observe("serve.device_seconds", dev)
+            fut.set_result(float(pctr[i]))
+        reg.counter_add("serve.requests", len(reqs))
+        reg.counter_add("serve.batches", 1.0)
+        reg.observe("serve.batch_size", float(len(reqs)))

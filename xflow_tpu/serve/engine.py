@@ -1,0 +1,433 @@
+"""PredictEngine — the low-latency scoring tier.
+
+Loads a frozen artifact (serve/artifact.py) or wraps a live trainer
+state, with **no Trainer, no ShardLoader, no optimizer state**: the
+engine owns a mesh, the model's predict computation, and param-only
+tables.  Two properties make it serving-grade:
+
+* **Shape-bucketed AOT compilation.**  ``XFlow.predict_batch``
+  historically re-traced/re-compiled for every distinct batch shape —
+  deadly under concurrent traffic where request batches are all sizes.
+  The engine snaps every request batch onto a small fixed set of padded
+  batch-size buckets (default 1/8/64/512, rounded up to mesh-divisible
+  sizes) and compiles the predict step **ahead of time, exactly once
+  per bucket** (``jax.jit(...).lower(...).compile()``), warmed at load.
+  ``compile_count`` is the hook: after ``warm()`` it equals
+  ``len(buckets)`` and MUST stay there under any traffic mix — a test
+  regression here means latency cliffs in production.
+
+* **Digest-checked identity.**  The engine refuses an artifact whose
+  manifest digest doesn't match its embedded config, and refuses to
+  load when the caller's expected config digests differently — scoring
+  through the wrong geometry fails loudly at load, not silently with
+  garbage pctr.
+
+The hot-table remap (io/freq.py) is folded in: artifacts carry it and
+``predict`` applies it to raw hash-space request keys via the shared
+io/batch.py::remap_batch, so external callers never see the permuted
+key space.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+import jax
+
+from xflow_tpu.config import Config
+from xflow_tpu.io.batch import Batch, pad_batch_rows, remap_batch
+from xflow_tpu.obs import NULL_OBS
+from xflow_tpu.parallel.mesh import make_mesh, replicated, table_sharding
+
+DEFAULT_BUCKETS = (1, 8, 64, 512)
+
+
+def _slice_rows(batch: Batch, start: int, stop: int) -> Batch:
+    return Batch(
+        keys=batch.keys[start:stop],
+        slots=batch.slots[start:stop],
+        vals=batch.vals[start:stop],
+        mask=batch.mask[start:stop],
+        labels=batch.labels[start:stop],
+        weights=batch.weights[start:stop],
+        hot_keys=batch.hot_keys[start:stop],
+        hot_slots=batch.hot_slots[start:stop],
+        hot_vals=batch.hot_vals[start:stop],
+        hot_mask=batch.hot_mask[start:stop],
+    )
+
+
+class PredictEngine:
+    """Compiled, bucketed predict over a frozen (or live) model state.
+
+    Construct directly from an in-memory state (the ``XFlow.predict_batch``
+    path wraps the live trainer state this way) or via ``load`` from an
+    exported artifact.  ``state`` may be a full training state — it is
+    stripped to param-only tables so the compiled executables never
+    carry optimizer aux arrays.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        state: dict[str, Any],
+        remap: np.ndarray | None = None,
+        mesh=None,
+        buckets: Sequence[int] | None = None,
+        obs=None,
+        digest: str | None = None,
+        warm: bool = False,
+    ):
+        from xflow_tpu.models import make_model
+        from xflow_tpu.parallel.step import TrainStep
+
+        self.cfg = cfg
+        self.digest = digest if digest is not None else cfg.digest()
+        self.mesh = mesh if mesh is not None else make_mesh(1)
+        ndev = self.mesh.devices.size
+        if cfg.table_size % ndev:
+            raise ValueError(
+                f"table_size {cfg.table_size} not divisible by the "
+                f"serving mesh's {ndev} devices"
+            )
+        if cfg.hot_size_log2 and remap is None:
+            raise ValueError(
+                "model was trained with a hot table but no remap was "
+                "provided — raw request keys cannot be translated"
+            )
+        self.model = make_model(cfg)
+        # The predict path never touches the optimizer; TrainStep is
+        # reused purely for its wire/gather/logit machinery.
+        self.step = TrainStep(self.model, None, cfg, self.mesh)
+        self.remap = remap
+        self.obs = obs if obs is not None else NULL_OBS
+        self.step.obs = self.obs
+        # Bucket sizes must divide over the mesh's batch axis: round
+        # each up to a multiple of ndev, dedupe, sort.
+        raw = tuple(buckets) if buckets else DEFAULT_BUCKETS
+        if any(b < 1 for b in raw):
+            raise ValueError(f"bucket sizes must be >= 1, got {raw}")
+        self.buckets = tuple(
+            sorted({-(-b // ndev) * ndev for b in raw})
+        )
+        self.state = self._strip_state(state)
+        # AOT executables keyed by (batch_rows, cold_nnz, hot_nnz) —
+        # canonical traffic only ever sees len(buckets) keys.
+        self._compiled: dict[tuple[int, int, int], Any] = {}
+        self.compile_count = 0
+        self.warm_seconds = 0.0
+        self._parse_fn = None
+        if warm:
+            self.warm()
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def load(
+        cls,
+        directory: str,
+        config: Config | None = None,
+        num_devices: int = 1,
+        buckets: Sequence[int] | None = None,
+        obs=None,
+        warm: bool = True,
+    ) -> "PredictEngine":
+        """Load an exported artifact.  ``config``, when given, is the
+        caller's expectation: its digest must equal the artifact's or
+        the load is refused (never score through the wrong model).
+        ``num_devices`` sizes the serving mesh (default 1 — the lean
+        scoring tier; the row-range shard files assemble onto any
+        mesh)."""
+        from xflow_tpu.serve.artifact import (
+            REMAP_FILE,
+            load_manifest,
+        )
+        from xflow_tpu.utils.checkpoint import RangeReader
+
+        manifest = load_manifest(directory)
+        cfg = Config.from_json(manifest["config"])
+        digest = manifest["config_digest"]
+        if config is not None and config.digest() != digest:
+            raise ValueError(
+                f"artifact {directory} was exported from config "
+                f"{digest}, but the expected config digests to "
+                f"{config.digest()} — refusing to serve a mismatched "
+                "model"
+            )
+        mesh = make_mesh(num_devices)
+        sharding = table_sharding(mesh)
+        import jax.numpy as jnp
+
+        from xflow_tpu.models import make_model
+
+        tables: dict[str, Any] = {}
+        for spec in make_model(cfg).tables():
+            key = f"{spec.name}.param"
+            meta = manifest["arrays"].get(key)
+            if meta is None:
+                raise ValueError(f"artifact {directory} missing {key}")
+            shape = tuple(meta["shape"])
+            reader = RangeReader(
+                directory, key, shape, np.dtype(meta["dtype"])
+            )
+            tables[spec.name] = {
+                "param": jax.make_array_from_callback(
+                    shape, sharding, reader.read
+                )
+            }
+        dense: dict[str, Any] = {}
+        for dname in manifest.get("dense", []):
+            host = np.load(os.path.join(directory, f"dense.{dname}.npy"))
+            dense[dname] = jax.device_put(host, replicated(mesh))
+        remap = None
+        if manifest.get("remap"):
+            remap = np.load(os.path.join(directory, REMAP_FILE))
+        state = {
+            "tables": tables,
+            "dense": dense,
+            "step": jnp.asarray(manifest["step"], jnp.int32),
+        }
+        return cls(
+            cfg,
+            state,
+            remap=remap,
+            mesh=mesh,
+            buckets=buckets,
+            obs=obs,
+            digest=digest,
+            warm=warm,
+        )
+
+    @staticmethod
+    def _strip_state(state: dict[str, Any]) -> dict[str, Any]:
+        """Param-only view of a (possibly full training) state: the
+        compiled executables should never ship FTRL n/z."""
+        return {
+            "tables": {
+                name: {"param": t["param"]}
+                for name, t in state["tables"].items()
+            },
+            "dense": state["dense"],
+            "step": state["step"],
+        }
+
+    def update_state(self, state: dict[str, Any]) -> None:
+        """Swap in newer weights (same shapes/shardings — e.g. the live
+        trainer state after more steps).  The AOT executables take the
+        state as an argument, so no recompilation happens."""
+        self.state = self._strip_state(state)
+
+    # -- warmup / compilation ----------------------------------------------
+
+    def warm(self) -> float:
+        """Compile every bucket now (one all-padding batch each) so the
+        first real request never pays an XLA compile; returns and
+        records the warmup seconds."""
+        t0 = time.perf_counter()
+        for b in self.buckets:
+            self.predict(self._empty_batch(b))
+        self.warm_seconds = time.perf_counter() - t0
+        return self.warm_seconds
+
+    def _empty_batch(self, rows: int) -> Batch:
+        k = self.cfg.max_nnz
+        return Batch(
+            keys=np.zeros((rows, k), np.int32),
+            slots=np.zeros((rows, k), np.int32),
+            vals=np.zeros((rows, k), np.float32),
+            mask=np.zeros((rows, k), np.float32),
+            labels=np.zeros(rows, np.float32),
+            weights=np.zeros(rows, np.float32),
+        )
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (the largest bucket for oversized
+        requests — predict() chunks those)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    # -- featurize ---------------------------------------------------------
+
+    def featurize_raw(self, rows: Sequence) -> Batch:
+        """Build a RAW-key-space Batch from single-row requests —
+        feed it to ``predict`` (which remaps/pads).  Each row is either
+        a 1-D key array or a ``(keys, slots, vals)`` tuple (slots/vals
+        may be None → 0 / 1.0, the hash-mode convention).  Features
+        beyond ``max_nnz`` are truncated, like the training loader."""
+        n = len(rows)
+        k = self.cfg.max_nnz
+        keys = np.zeros((n, k), np.int32)
+        slots = np.zeros((n, k), np.int32)
+        vals = np.zeros((n, k), np.float32)
+        mask = np.zeros((n, k), np.float32)
+        for i, row in enumerate(rows):
+            if isinstance(row, tuple):
+                rk, rs, rv = row
+            else:
+                rk, rs, rv = row, None, None
+            rk = np.asarray(rk)
+            m = min(len(rk), k)
+            keys[i, :m] = rk[:m]
+            if rs is not None:
+                slots[i, :m] = np.asarray(rs)[:m]
+            vals[i, :m] = 1.0 if rv is None else np.asarray(rv)[:m]
+            mask[i, :m] = 1.0
+        return Batch(
+            keys=keys, slots=slots, vals=vals, mask=mask,
+            labels=np.zeros(n, np.float32),
+            weights=np.ones(n, np.float32),
+        )
+
+    def featurize(self, rows: Sequence) -> Batch:
+        """``featurize_raw`` + prepare (remap/steer) + pad to the
+        covering bucket: the Batch is ready for ``predict_prepared``
+        (the batcher's featurize leg).  ``rows`` must fit the largest
+        bucket — callers with bigger batches use ``predict``, which
+        chunks.  Never feed the result to ``predict``: that would
+        apply the remap twice."""
+        n = len(rows)
+        if n > self.buckets[-1]:
+            raise ValueError(
+                f"featurize: {n} rows exceed the largest bucket "
+                f"{self.buckets[-1]} — use predict(featurize_raw(rows))"
+            )
+        return pad_batch_rows(
+            self._prepare(self.featurize_raw(rows)), self.bucket_for(n)
+        )
+
+    def score_text(self, lines: Iterable[str]) -> np.ndarray:
+        """pctr for libffm-format text lines (``label\\tfgid:fid:val``,
+        label ignored) — the CLI ``score`` and C-ABI ``XFEngineScore``
+        featurize path.  Uses the training parse fn (same hashing/seed,
+        from the artifact config) but NO ShardLoader."""
+        from xflow_tpu.io.batch import pack_batch
+        from xflow_tpu.io.loader import make_parse_fn
+
+        if self._parse_fn is None:
+            cfg = self.cfg
+            self._parse_fn = make_parse_fn(
+                cfg.table_size,
+                cfg.hash_mode,
+                cfg.seed,
+                prefer_native=cfg.native_parser,
+            )
+        data = "".join(
+            line if line.endswith("\n") else line + "\n" for line in lines
+        ).encode()
+        block = self._parse_fn(data)
+        n = block.num_samples
+        if n == 0:
+            return np.zeros(0, np.float32)
+        out = []
+        cap = self.buckets[-1]
+        for s in range(0, n, cap):
+            e = min(s + cap, n)
+            raw = pack_batch(block, s, e, e - s, self.cfg.max_nnz)
+            out.append(self.predict(raw))
+        return np.concatenate(out)
+
+    # -- predict -----------------------------------------------------------
+
+    def _prepare(self, batch: Batch) -> Batch:
+        """Canonicalize an external raw-key-space batch: widen the cold
+        section so the total feature width matches the training
+        geometry (narrower batches get zero-mask columns — no new
+        compile shapes), then apply the hot remap + steering.
+
+        Batches WIDER than the training geometry keep their width
+        (truncating would silently drop features the training path
+        kept) and compile one extra executable per distinct width —
+        counted in ``serve.noncanonical_shape``.  The batcher/featurize
+        tier only ever produces canonical widths, so the no-recompile
+        guarantee holds for serving traffic; a direct ``predict``
+        caller who wants it too must match ``cfg.max_nnz``."""
+        cfg = self.cfg
+        if batch.hot_nnz and not cfg.hot_size:
+            raise ValueError(
+                "batch carries hot planes but the model has no hot table"
+            )
+        total = batch.hot_nnz + batch.max_nnz
+        if total > cfg.max_nnz:
+            self.obs.counter("serve.noncanonical_shape")
+        if total < cfg.max_nnz:
+            pad = cfg.max_nnz - total
+            b = batch.batch_size
+            z_i = np.zeros((b, pad), np.int32)
+            z_f = np.zeros((b, pad), np.float32)
+            batch = Batch(
+                keys=np.concatenate([batch.keys, z_i], axis=1),
+                slots=np.concatenate([batch.slots, z_i], axis=1),
+                vals=np.concatenate([batch.vals, z_f], axis=1),
+                mask=np.concatenate([batch.mask, z_f], axis=1),
+                labels=batch.labels,
+                weights=batch.weights,
+                hot_keys=batch.hot_keys,
+                hot_slots=batch.hot_slots,
+                hot_vals=batch.hot_vals,
+                hot_mask=batch.hot_mask,
+            )
+        return remap_batch(batch, self.remap, cfg.hot_size, cfg.hot_nnz)
+
+    def predict(self, batch: Batch) -> np.ndarray:
+        """pctr for one externally built Batch (raw hash key space —
+        the remap is applied here).  Any batch size: rows pad up to the
+        smallest covering bucket; oversized batches chunk by the
+        largest bucket.  Returns exactly ``batch.batch_size`` values."""
+        n = batch.batch_size
+        batch = self._prepare(batch)
+        cap = self.buckets[-1]
+        if n <= cap:
+            padded = pad_batch_rows(batch, self.bucket_for(n))
+            return self.predict_prepared(padded)[:n]
+        out = []
+        for s in range(0, n, cap):
+            e = min(s + cap, n)
+            chunk = pad_batch_rows(
+                _slice_rows(batch, s, e), self.bucket_for(e - s)
+            )
+            out.append(self.predict_prepared(chunk)[: e - s])
+        return np.concatenate(out)
+
+    def predict_prepared(self, batch: Batch) -> np.ndarray:
+        """Run one already-prepared, bucket-sized batch on the device;
+        returns pctr for every row (padding included).  This is the
+        'device' leg of the batcher's latency accounting: h2d +
+        execute + fetch."""
+        key = (batch.batch_size, batch.max_nnz, batch.hot_nnz)
+        if self.step.compact_wire:
+            # TrainStep validates compact-wire invariants only on its
+            # FIRST batch (fine for uniform loader traffic); serving
+            # traffic is heterogeneous, so a value-carrying request
+            # after warmup would otherwise have its vals silently
+            # replaced by 1.0 — validate every batch (O(B·K) numpy,
+            # noise next to the device call at serving batch sizes).
+            from xflow_tpu.parallel.step import validate_compact_batch
+
+            validate_compact_batch(batch)
+        arrays = self.step.put_batch(batch)  # books the 'h2d' phase
+        exe = self._compiled.get(key)
+        if exe is None:
+            with self.obs.phase("serve_compile"):
+                exe = (
+                    jax.jit(self.step._predict_impl)
+                    .lower(self.state, arrays)
+                    .compile()
+                )
+            self._compiled[key] = exe
+            self.compile_count += 1
+            self.obs.counter("serve.compiles")
+        with self.obs.phase("serve_execute"):
+            garr = exe(self.state, arrays)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                garr = multihost_utils.global_array_to_host_local_array(
+                    garr, self.mesh, self.step._bsharding.spec
+                )
+            return np.asarray(jax.device_get(garr))

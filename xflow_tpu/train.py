@@ -148,6 +148,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--resume", action="store_true", help="resume from latest checkpoint")
     p.add_argument(
+        "--export-artifact", dest="export_artifact",
+        help="after training, freeze the model into a serving artifact "
+        "at this directory (serve/artifact.py; score it with "
+        "`python -m xflow_tpu.serve` — docs/SERVING.md)",
+    )
+    p.add_argument(
         "--platform",
         choices=["tpu", "cpu", "gpu"],
         help="force the JAX backend (overrides plugin auto-selection; "
@@ -218,6 +224,11 @@ def main(argv: list[str] | None = None) -> int:
             return 0
         if cfg.test_path and not args.skip_eval:
             trainer.evaluate()
+        if args.export_artifact:
+            from xflow_tpu.serve.artifact import export_artifact
+
+            path = export_artifact(trainer, args.export_artifact)
+            print(f"exported serving artifact to {path}", file=sys.stderr)
     return 0
 
 

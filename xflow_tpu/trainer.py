@@ -135,13 +135,9 @@ class Trainer:
         """Contents of the metrics file's ``run_start`` delimiter row:
         enough to tell two appended runs apart (the file opens in append
         mode) and to check their configs match without any log parsing."""
-        import hashlib
-
         return {
             "run_id": f"{int(time.time() * 1000):x}-{os.getpid():x}",
-            "config_digest": hashlib.sha256(
-                self.cfg.to_json().encode()
-            ).hexdigest()[:12],
+            "config_digest": self.cfg.digest(),
             "rank": self.host,
             "num_hosts": self.num_hosts,
             "model": self.cfg.model,
@@ -410,29 +406,13 @@ class Trainer:
         """Bring an externally built Batch (raw hash-space keys, see
         io/batch.py) into this model's key space: apply the hot remap
         and re-steer the hot/cold sections.  Loader-produced batches are
-        already prepared; this is for user-supplied batches
-        (api.XFlow.predict_batch)."""
-        if self.remap is None:
-            return batch
-        from xflow_tpu.io.batch import make_batch
+        already prepared; this is for user-supplied batches.  Delegates
+        to the shared io/batch.py::remap_batch (also the serving
+        engine's prepare path — serve/engine.py)."""
+        from xflow_tpu.io.batch import remap_batch
 
-        # merge any existing hot section back, remap, then re-steer (a
-        # remapped key may cross the hot/cold boundary in either direction);
-        # pad by hot_nnz columns so the post-split cold capacity equals the
-        # full incoming width — even if every incoming entry lands cold,
-        # nothing is truncated on re-steer
-        kh = self.cfg.hot_nnz
-        b = batch.batch_size
-        pad_i = np.zeros((b, kh), np.int32)
-        pad_f = np.zeros((b, kh), np.float32)
-        keys = np.concatenate([batch.hot_keys, batch.keys, pad_i], axis=1)
-        slots = np.concatenate([batch.hot_slots, batch.slots, pad_i], axis=1)
-        vals = np.concatenate([batch.hot_vals, batch.vals, pad_f], axis=1)
-        mask = np.concatenate([batch.hot_mask, batch.mask, pad_f], axis=1)
-        keys = np.where(mask > 0, self.remap[keys], 0).astype(np.int32)
-        return make_batch(
-            keys, slots, vals, mask, batch.labels, batch.weights,
-            self.cfg.hot_size, self.cfg.hot_nnz,
+        return remap_batch(
+            batch, self.remap, self.cfg.hot_size, self.cfg.hot_nnz
         )
 
     # -- training ----------------------------------------------------------

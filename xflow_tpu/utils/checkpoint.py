@@ -39,12 +39,13 @@ MANIFEST = "manifest.json"
 _RANGE_RE = re.compile(r"\.r(\d+)-(\d+)\.npy$")
 
 
-def _all_ok(local_ok: bool) -> bool:
+def all_ok(local_ok: bool) -> bool:
     """True iff every process reports success.  Doubles as a barrier, so
     a process that FAILED its local I/O still reaches this point and the
     others learn about the failure instead of deadlocking in a plain
     sync (every code path on every process must call this the same
-    number of times)."""
+    number of times).  Public: serve/artifact.py runs the same
+    write-shards/vote/finalize protocol for inference artifacts."""
     if jax.process_count() == 1:
         return local_ok
     from jax.experimental import multihost_utils
@@ -61,10 +62,11 @@ class IncompatibleCheckpoint(ValueError):
     'no usable checkpoint' rather than crashing."""
 
 
-def _iter_owned_shards(arr: jax.Array):
+def iter_owned_shards(arr: jax.Array):
     """(start_row, stop_row, host_data) for every addressable shard this
     process is responsible for writing (replica 0 of each distinct row
-    range — replicated copies on other devices/processes skip)."""
+    range — replicated copies on other devices/processes skip).
+    Public: shared with serve/artifact.py's export."""
     seen: set[tuple[int, int]] = set()
     nrows = arr.shape[0]
     for shard in arr.addressable_shards:
@@ -112,11 +114,11 @@ def save_checkpoint(
     final = os.path.join(directory, f"ckpt-{step:010d}")
     tmp = os.path.join(directory, f".tmp-ckpt-{step:010d}")
     proc = jax.process_index()
-    # Every process passes through ALL THREE _all_ok gates on every
+    # Every process passes through ALL THREE all_ok gates on every
     # path, so a local I/O failure at any stage — including process 0's
     # mkdir, which runs before any peer has work to do — is reported to
     # the peers instead of leaving them deadlocked (a bare barrier here
-    # would hang: the failing process would enter _all_ok's allgather
+    # would hang: the failing process would enter all_ok's allgather
     # while the others sit in sync_global_devices).
     err: BaseException | None = None
     try:
@@ -127,7 +129,7 @@ def save_checkpoint(
             os.makedirs(tmp)
     except BaseException as e:
         err = e
-    if not _all_ok(err is None):
+    if not all_ok(err is None):
         if err is not None:
             raise err
         raise RuntimeError(
@@ -140,7 +142,7 @@ def save_checkpoint(
                 "shape": list(arr.shape),
                 "dtype": str(arr.dtype),
             }
-            for start, stop, host_data in _iter_owned_shards(arr):
+            for start, stop, host_data in iter_owned_shards(arr):
                 np.save(
                     os.path.join(tmp, f"{key}.r{start:012d}-{stop:012d}.npy"),
                     host_data,
@@ -154,7 +156,7 @@ def save_checkpoint(
                 )
     except BaseException as e:
         err = e
-    if not _all_ok(err is None):
+    if not all_ok(err is None):
         if proc == 0:
             shutil.rmtree(tmp, ignore_errors=True)
         if err is not None:
@@ -182,7 +184,7 @@ def save_checkpoint(
                 gc_checkpoints(directory, keep)
     except BaseException as e:
         err = e
-    if not _all_ok(err is None):
+    if not all_ok(err is None):
         if proc == 0:
             shutil.rmtree(tmp, ignore_errors=True)
         if err is not None:
@@ -243,7 +245,7 @@ def latest_checkpoint(directory: str) -> str | None:
     return os.path.join(directory, cands[-1]) if cands else None
 
 
-class _RangeReader:
+class RangeReader:
     """Assembles arbitrary row/col slices of one array from its
     row-range .npy files via mmap — peak memory O(requested slice)."""
 
@@ -311,7 +313,7 @@ def load_checkpoint(
                     f"checkpoint array {key} shape {tuple(meta['shape'])} "
                     f"!= state {arr.shape}"
                 )
-            reader = _RangeReader(path, key, arr.shape, np.dtype(meta["dtype"]))
+            reader = RangeReader(path, key, arr.shape, np.dtype(meta["dtype"]))
             new_tables[tname][aname] = jax.make_array_from_callback(
                 arr.shape, arr.sharding, reader.read
             )
