@@ -1,0 +1,61 @@
+"""Static-analysis gate: run the xflow_tpu.analysis rule pass (XF001
+recompile hazards, XF002 hidden host syncs, XF003 lock discipline,
+XF004 schema drift, XF005 C-ABI parity — docs/ANALYSIS.md) over the
+whole package against the committed baseline.
+
+Run from the repo root:
+
+    python scripts/check_analysis.py
+
+Wired into tier-1 next to check_metrics_schema.py/check_serve_smoke.py
+(tests/test_analysis.py::test_check_analysis_script), so a careless
+edit that reintroduces a per-shape recompile, an unbooked host sync, an
+unlocked mutation of loader/batcher state, an undeclared JSONL kind, or
+a one-sided ABI change fails CI instead of surfacing in production.
+
+Unlike the two runtime lints this one never executes the pipeline — it
+is pure AST over the source tree, so it stays fast and works in images
+without a functional accelerator backend.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    from xflow_tpu.analysis import (
+        load_baseline,
+        render_text,
+        run_analysis,
+        split_baselined,
+    )
+
+    package = os.path.join(REPO, "xflow_tpu")
+    baseline = os.path.join(REPO, "analysis-baseline.json")
+    findings, pragma_suppressed = run_analysis([package])
+    new, grandfathered, stale = split_baselined(
+        findings, load_baseline(baseline)
+    )
+    print(render_text(new, grandfathered, pragma_suppressed, stale))
+    if new:
+        return 1
+    if stale:
+        # a stale entry means a grandfathered finding got fixed — the
+        # baseline must shrink with it, or it will silently grandfather
+        # a future regression with the same message
+        print(
+            "FAIL: stale baseline entries (prune analysis-baseline.json)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,713 @@
+"""xflow_tpu.analysis: rule-engine fixtures (every rule fires on its
+minimal repro and stays silent on the idiomatic pattern), pragma +
+baseline round-trips, the CLI/JSON contract, the tier-1 gate script,
+and the lock-stress runtime companion backing XF003 (docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from xflow_tpu.analysis import (
+    load_baseline,
+    run_analysis,
+    split_baselined,
+    write_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def scan(tmp_path, files: dict[str, str], select=None):
+    """Write a fixture tree and run the pass over it."""
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+    findings, suppressed = run_analysis([str(tmp_path)], select=select)
+    return findings, suppressed
+
+
+def rules_fired(findings):
+    return {f.rule for f in findings}
+
+
+# -- XF001: recompile hazards ---------------------------------------------
+
+
+def test_xf001_jit_in_loop_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import jax\n"
+        "def f(tables):\n"
+        "    outs = []\n"
+        "    for t in tables:\n"
+        "        g = jax.jit(lambda x: x + 1)\n"
+        "        outs.append(g(t))\n"
+        "    return outs\n"
+    )}, select=["XF001"])
+    assert [f.rule for f in findings] == ["XF001"]
+    assert findings[0].line == 5
+
+
+def test_xf001_immediate_invoke_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.jit(lambda v: v * 2)(x)\n"
+    )}, select=["XF001"])
+    assert rules_fired(findings) == {"XF001"}
+
+
+def test_xf001_scalar_literal_into_jitted_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import jax\n"
+        "def impl(x, lr):\n"
+        "    return x * lr\n"
+        "step = jax.jit(impl)\n"
+        "def run(x):\n"
+        "    return step(x, 0.05)\n"
+    )}, select=["XF001"])
+    assert rules_fired(findings) == {"XF001"}
+    assert "scalar literal" in findings[0].message
+
+
+def test_xf001_shape_derived_into_jitted_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import jax\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.predict = jax.jit(self._impl)\n"
+        "    def _impl(self, x, n):\n"
+        "        return x[:n]\n"
+        "    def run(self, x):\n"
+        "        return self.predict(x, x.shape[0] // 2)\n"
+    )}, select=["XF001"])
+    assert rules_fired(findings) == {"XF001"}
+    assert ".shape-derived" in findings[0].message
+
+
+def test_xf001_silent_on_idiomatic(tmp_path):
+    # module-level binding, array args, static_argnums, and the AOT
+    # .lower().compile() idiom (serve/engine.py) must all stay quiet
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def impl(x, y):\n"
+        "    return x + y\n"
+        "step = jax.jit(impl)\n"
+        "sized = jax.jit(impl, static_argnums=1)\n"
+        "def run(x):\n"
+        "    exe = jax.jit(impl).lower(x, x).compile()\n"
+        "    return step(x, jnp.asarray(x)), sized(x, 4), exe(x, x)\n"
+    )}, select=["XF001"])
+    assert findings == []
+
+
+# -- XF002: hidden host syncs ---------------------------------------------
+
+
+def test_xf002_float_in_jitted_function_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return float(x.sum())\n"
+    )}, select=["XF002"])
+    assert rules_fired(findings) == {"XF002"}
+    assert "float()" in findings[0].message
+
+
+def test_xf002_numpy_in_traced_closure_fires(tmp_path):
+    # helper reached through the traced call graph (jax.jit(self._impl)
+    # seed -> self._helper closure), numpy materialization inside
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import jax\n"
+        "import numpy as np\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.train = jax.jit(self._impl)\n"
+        "    def _impl(self, x):\n"
+        "        return self._helper(x)\n"
+        "    def _helper(self, x):\n"
+        "        return np.asarray(x) + 1\n"
+    )}, select=["XF002"])
+    assert rules_fired(findings) == {"XF002"}
+    assert "asarray" in findings[0].message
+
+
+def test_xf002_scan_body_is_traced(tmp_path):
+    # nested defs inside a traced fn (lax.scan bodies) are traced too
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(xs):\n"
+        "    def body(carry, x):\n"
+        "        return carry + int(x.sum()), None\n"
+        "    return jax.lax.scan(body, 0, xs)\n"
+    )}, select=["XF002"])
+    assert rules_fired(findings) == {"XF002"}
+
+
+def test_xf002_host_code_is_silent(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import numpy as np\n"
+        "def host(rows):\n"
+        "    return float(np.asarray(rows).sum())\n"
+    )}, select=["XF002"])
+    assert findings == []
+
+
+def test_xf002_sync_outside_span_in_hot_module_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"serve/eng.py": (
+        "import jax\n"
+        "def fetch(garr):\n"
+        "    return jax.device_get(garr)\n"
+    )}, select=["XF002"])
+    assert rules_fired(findings) == {"XF002"}
+    assert "phase/span" in findings[0].message
+
+
+def test_xf002_sync_inside_span_or_cold_module_is_silent(tmp_path):
+    findings, _ = scan(tmp_path, {
+        "serve/eng.py": (
+            "import jax\n"
+            "def fetch(obs, garr):\n"
+            "    with obs.phase('device_block'):\n"
+            "        return jax.device_get(garr)\n"
+        ),
+        # utils/ is not a hot-path module: export/checkpoint cold paths
+        "utils/ck.py": (
+            "import jax\n"
+            "def fetch(garr):\n"
+            "    return jax.device_get(garr)\n"
+        ),
+    }, select=["XF002"])
+    assert findings == []
+
+
+# -- XF003: lock discipline -----------------------------------------------
+
+_XF003_POSITIVE = (
+    "import threading\n"
+    "class Shared:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._state = {}\n"
+    "        self._n = 0\n"
+    "    def locked_add(self, k, v):\n"
+    "        with self._lock:\n"
+    "            self._state[k] = v\n"
+    "            self._n += 1\n"
+    "    def racy_reset(self):\n"
+    "        self._n = 0\n"
+)
+
+
+def test_xf003_unlocked_write_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": _XF003_POSITIVE},
+                       select=["XF003"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "XF003" and f.line == 12 and "_n" in f.message
+
+
+def test_xf003_subscript_store_counts_as_write(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import threading\n"
+        "class Shared:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._state = {}\n"
+        "    def locked(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._state[k] = v\n"
+        "    def racy(self, k, v):\n"
+        "        self._state[k] = v\n"
+    )}, select=["XF003"])
+    assert len(findings) == 1 and "_state" in findings[0].message
+
+
+def test_xf003_silent_when_disciplined(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import threading\n"
+        "class Shared:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"          # __init__ writes are exempt
+        "    def add(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"
+        "class NoLock:\n"               # lockless classes out of scope
+        "    def set(self, v):\n"
+        "        self.v = v\n"
+    )}, select=["XF003"])
+    assert findings == []
+
+
+# -- XF004: schema drift --------------------------------------------------
+
+_SCHEMA_FIXTURE = (
+    "SCHEMA = {\n"
+    "    'train_epoch': {'t': float},\n"
+    "    'eval': {'t': float},\n"
+    "}\n"
+)
+
+
+def test_xf004_undeclared_kind_fires(tmp_path):
+    findings, _ = scan(tmp_path, {
+        "obs/schema.py": _SCHEMA_FIXTURE,
+        "serve/s.py": "def f(lg):\n    lg.log('bogus_kind', {'t': 1})\n",
+    }, select=["XF004"])
+    assert len(findings) == 1
+    assert "bogus_kind" in findings[0].message
+    assert findings[0].path == "serve/s.py"
+
+
+def test_xf004_unused_kind_fires_on_whole_package_scan(tmp_path):
+    findings, _ = scan(tmp_path, {
+        "obs/schema.py": _SCHEMA_FIXTURE,
+        # trainer.py present == whole-package scan sentinel
+        "trainer.py": "def f(lg):\n    lg.log('train_epoch', {'t': 1})\n",
+    }, select=["XF004"])
+    assert len(findings) == 1
+    assert "'eval'" in findings[0].message
+    assert findings[0].path == "obs/schema.py"
+
+
+def test_xf004_silent_on_subtree_scan_and_on_parity(tmp_path):
+    # no trainer.py: the unused-kind direction must not misfire on a
+    # subtree scan that legitimately emits only some kinds
+    findings, _ = scan(tmp_path, {
+        "obs/schema.py": _SCHEMA_FIXTURE,
+        "serve/s.py": "def f(lg):\n    lg.log('eval', {'t': 1})\n",
+    }, select=["XF004"])
+    assert findings == []
+
+
+# -- XF005: C-ABI parity --------------------------------------------------
+
+_HEADER_OK = (
+    "typedef void* XFHandle;\n"
+    "XFHandle XFCreate(const char* p);\n"
+    "void XFDestroy(XFHandle h);\n"
+)
+_CC_OK = (
+    "// shims\n"
+    "XFHandle XFCreate(const char* p) {\n"
+    "  return call_impl(\"create\", 0);\n"
+    "}\n"
+    "void XFDestroy(XFHandle h) {}\n"
+)
+_CAPI_OK = "def create(p):\n    return p\n"
+
+
+def _abi_tree(header, cc, capi):
+    return {
+        "native/include/xflow_tpu.h": header,
+        "native/src/c_api.cc": cc,
+        "capi_impl.py": capi,
+    }
+
+
+def test_xf005_parity_is_silent(tmp_path):
+    findings, _ = scan(
+        tmp_path, _abi_tree(_HEADER_OK, _CC_OK, _CAPI_OK), select=["XF005"]
+    )
+    assert findings == []
+
+
+def test_xf005_missing_definition_fires(tmp_path):
+    header = _HEADER_OK + "int XFTrain(XFHandle h);\n"
+    findings, _ = scan(
+        tmp_path, _abi_tree(header, _CC_OK, _CAPI_OK), select=["XF005"]
+    )
+    assert len(findings) == 1
+    assert "XFTrain" in findings[0].message
+    assert findings[0].path.endswith("xflow_tpu.h")
+
+
+def test_xf005_orphan_definition_and_missing_impl_fire(tmp_path):
+    cc = _CC_OK + (
+        "int XFExtra(XFHandle h) {\n"
+        "  return call_impl(\"missing_impl\", 0) ? 0 : -1;\n"
+        "}\n"
+    )
+    findings, _ = scan(
+        tmp_path, _abi_tree(_HEADER_OK, cc, _CAPI_OK), select=["XF005"]
+    )
+    messages = " | ".join(f.message for f in findings)
+    assert "XFExtra" in messages          # defined but not declared
+    assert "missing_impl" in messages     # call_impl target absent
+
+
+def test_xf005_orphan_python_impl_fires(tmp_path):
+    capi = _CAPI_OK + "def unused_public(x):\n    return x\n"
+    findings, _ = scan(
+        tmp_path, _abi_tree(_HEADER_OK, _CC_OK, capi), select=["XF005"]
+    )
+    assert len(findings) == 1
+    assert "unused_public" in findings[0].message
+
+
+def test_xf005_symbols_in_comments_ignored(tmp_path):
+    header = "/* XFGhost(int) is not real */\n" + _HEADER_OK
+    cc = "// XFPhantom() also not real\n" + _CC_OK
+    findings, _ = scan(
+        tmp_path, _abi_tree(header, cc, _CAPI_OK), select=["XF005"]
+    )
+    assert findings == []
+
+
+# -- pragmas & baseline ---------------------------------------------------
+
+
+def test_pragma_suppresses_on_line_and_from_preceding_comment(tmp_path):
+    findings, suppressed = scan(tmp_path, {"mod.py": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    a = float(x.sum())  # xf: ignore[XF002]\n"
+        "    # deliberate sync, see docs (xf: ignore[XF002])\n"
+        "    b = float(x.max())\n"
+        "    return a + b\n"
+    )}, select=["XF002"])
+    assert findings == []
+    assert len(suppressed) == 2
+
+
+def test_pragma_wrong_rule_does_not_suppress(tmp_path):
+    findings, suppressed = scan(tmp_path, {"mod.py": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return float(x.sum())  # xf: ignore[XF001]\n"
+    )}, select=["XF002"])
+    assert len(findings) == 1 and suppressed == []
+
+
+def test_file_pragma_suppresses_whole_file(tmp_path):
+    findings, suppressed = scan(tmp_path, {"mod.py": (
+        "# xf: ignore-file[XF003]\n" + _XF003_POSITIVE
+    )}, select=["XF003"])
+    assert findings == [] and len(suppressed) == 1
+
+
+def test_pragma_in_docstring_or_string_does_not_register(tmp_path):
+    # pragma syntax QUOTED in a docstring or string literal must not
+    # suppress anything — only real # comments count (tokenize-based)
+    findings, suppressed = scan(tmp_path, {"mod.py": (
+        '"""Suppress with xf: ignore-file[XF003] pragmas."""\n'
+        "SYNTAX = 'xf: ignore[XF003]'\n" + _XF003_POSITIVE
+    )}, select=["XF003"])
+    assert len(findings) == 1 and suppressed == []
+
+
+def test_baseline_regeneration_preserves_justifications(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": _XF003_POSITIVE},
+                       select=["XF003"])
+    baseline = tmp_path / "baseline.json"
+    write_baseline(str(baseline), findings)
+    entries = load_baseline(str(baseline))
+    entries[0]["justification"] = "legacy worker, rewrite scheduled"
+    with open(baseline, "w") as f:
+        json.dump({"findings": entries}, f)
+    # regenerate: the hand-written field must survive
+    write_baseline(str(baseline), findings,
+                   previous=load_baseline(str(baseline)))
+    kept = load_baseline(str(baseline))
+    assert kept[0]["justification"] == "legacy worker, rewrite scheduled"
+
+
+def test_batcher_failing_close_releases_concurrent_closers():
+    """A first closer whose stats flush raises must not leave other
+    closers blocked forever on the drain event (they fail fast)."""
+    from xflow_tpu.serve.batcher import MicroBatcher
+
+    batcher = MicroBatcher(_FakeEngine(), max_wait_ms=0.5)
+    batcher.emit_stats = lambda: (_ for _ in ()).throw(
+        RuntimeError("boom")
+    )
+    outcomes: list[BaseException] = []
+
+    def first():
+        try:
+            batcher.close()
+        except BaseException as e:
+            outcomes.append(e)
+
+    t = threading.Thread(target=first)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    with pytest.raises((AssertionError, RuntimeError)):
+        batcher.close()  # must return/raise promptly, never hang
+    assert len(outcomes) == 1 and isinstance(outcomes[0], RuntimeError)
+
+
+def test_baseline_round_trip(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": _XF003_POSITIVE},
+                       select=["XF003"])
+    assert findings
+    baseline = tmp_path / "baseline.json"
+    write_baseline(str(baseline), findings)
+    entries = load_baseline(str(baseline))
+    new, grandfathered, stale = split_baselined(findings, entries)
+    assert new == [] and len(grandfathered) == len(findings) and stale == []
+
+
+def test_baseline_matching_survives_line_drift_and_reports_stale(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": _XF003_POSITIVE},
+                       select=["XF003"])
+    baseline = tmp_path / "baseline.json"
+    write_baseline(str(baseline), findings)
+    # shift every line: the finding moves but must still match
+    (tmp_path / "mod.py").write_text("# prologue\n" + _XF003_POSITIVE)
+    moved, _ = run_analysis([str(tmp_path)], select=["XF003"])
+    new, grandfathered, stale = split_baselined(
+        moved, load_baseline(str(baseline))
+    )
+    assert new == [] and len(grandfathered) == 1
+    # fix the defect: the entry must surface as stale, not linger
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    fixed, _ = run_analysis([str(tmp_path)], select=["XF003"])
+    new, grandfathered, stale = split_baselined(
+        fixed, load_baseline(str(baseline))
+    )
+    assert fixed == [] and len(stale) == 1
+
+
+# -- CLI + tier-1 gate ----------------------------------------------------
+
+
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "xflow_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+
+
+def test_cli_json_contract_and_exit_codes(tmp_path):
+    (tmp_path / "mod.py").write_text(_XF003_POSITIVE)
+    proc = _run_cli([str(tmp_path), "--format", "json"], cwd=str(tmp_path))
+    assert proc.returncode == 1, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is False
+    assert doc["counts"]["new"] == 1
+    assert doc["counts"]["by_rule"] == {"XF003": 1}
+    assert doc["findings"][0]["rule"] == "XF003"
+    # write a baseline, rerun: grandfathered, exit 0
+    proc = _run_cli(
+        [str(tmp_path), "--write-baseline"], cwd=str(tmp_path)
+    )
+    assert proc.returncode == 0, proc.stderr
+    proc = _run_cli([str(tmp_path), "--format", "json"], cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True and doc["counts"]["grandfathered"] == 1
+
+
+def test_cli_nonzero_on_every_rule_repro(tmp_path):
+    """One tree holding each rule's minimal repro: the CLI exits
+    non-zero and the JSON by_rule counts show all five rule IDs."""
+    files = {
+        "a.py": (
+            "import jax\n"
+            "def f(ts):\n"
+            "    for t in ts:\n"
+            "        g = jax.jit(lambda x: x)\n"
+        ),
+        "b.py": (
+            "import jax\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return float(x.sum())\n"
+        ),
+        "c.py": _XF003_POSITIVE,
+        "obs/schema.py": _SCHEMA_FIXTURE,
+        "trainer.py": "def f(lg):\n    lg.log('bogus', {'t': 1})\n",
+        "native/include/xflow_tpu.h": _HEADER_OK
+        + "int XFTrain(XFHandle h);\n",
+        "native/src/c_api.cc": _CC_OK,
+        "capi_impl.py": _CAPI_OK,
+    }
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+    proc = _run_cli([str(tmp_path), "--format", "json"], cwd=str(tmp_path))
+    assert proc.returncode == 1, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert set(doc["counts"]["by_rule"]) >= {
+        "XF001", "XF002", "XF003", "XF004", "XF005"
+    }
+
+
+def test_cli_select_unknown_rule_is_usage_error(tmp_path):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    proc = _run_cli([str(tmp_path), "--select", "XF999"], cwd=str(tmp_path))
+    assert proc.returncode == 2
+
+
+def test_repo_tree_is_clean():
+    """The acceptance gate: the shipped tree passes its own analyzer
+    (pragmas justified inline, baseline empty)."""
+    proc = _run_cli(["xflow_tpu"], cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_check_analysis_script():
+    """The CI gate script passes — run as a subprocess exactly as CI
+    does (same pattern as check_metrics_schema/check_serve_smoke)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_analysis.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- XF003's runtime companion: lock-stress -------------------------------
+
+
+class _FakeEngine:
+    """Minimal engine contract for MicroBatcher: echo scoring (pctr of
+    a request == its single key value), no jax involved."""
+
+    buckets = (1, 8, 64)
+    digest = "fake0000"
+
+    def featurize(self, rows):
+        return [keys for keys, _, _ in rows]
+
+    def predict_prepared(self, batch):
+        return np.asarray([float(k[0]) for k in batch])
+
+
+@pytest.mark.parametrize("n_threads", [8])
+def test_lock_stress_microbatcher_no_lost_updates(n_threads):
+    """Hammer MicroBatcher from >= 8 threads with a barrier start: every
+    future resolves to ITS request's value (no crossed futures), the
+    stats counters account for every request exactly once, and
+    concurrent close() calls all return the same final row."""
+    from xflow_tpu.serve.batcher import MicroBatcher
+
+    per_thread = 50
+    total = n_threads * per_thread
+    batcher = MicroBatcher(_FakeEngine(), max_wait_ms=0.5)
+    barrier = threading.Barrier(n_threads)
+    results: dict[int, list] = {}
+    errors: list[BaseException] = []
+
+    def worker(tid: int):
+        try:
+            barrier.wait()
+            futs = [
+                (v, batcher.submit(np.asarray([v])))
+                for v in range(tid * per_thread, (tid + 1) * per_thread)
+            ]
+            results[tid] = [(v, f.result(timeout=30)) for v, f in futs]
+        except BaseException as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    # no crossed or torn futures: each request got exactly its value
+    for tid, pairs in results.items():
+        for v, got in pairs:
+            assert got == float(v)
+    # concurrent close: all callers see the SAME final stats row
+    closed: list[dict] = []
+    close_barrier = threading.Barrier(n_threads)
+
+    def closer():
+        close_barrier.wait()
+        closed.append(batcher.close())
+
+    threads = [threading.Thread(target=closer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert len(closed) == n_threads
+    assert all(c == closed[0] for c in closed)
+    # no lost updates in the serve counters
+    stats = closed[0]
+    assert stats["requests"] == total
+    assert 1 <= stats["batches"] <= total
+
+
+def test_lock_stress_metrics_registry_exact_counts():
+    """8 threads, barrier start, fixed per-thread work: counters sum
+    exactly, histogram count is exact (no torn Histogram state), and a
+    racing snapshot(reset=True) never double-counts or drops."""
+    from xflow_tpu.obs.registry import MetricsRegistry
+
+    n_threads, adds, observes = 8, 2000, 500
+    reg = MetricsRegistry()
+    barrier = threading.Barrier(n_threads)
+
+    def worker():
+        barrier.wait()
+        for _ in range(adds):
+            reg.counter_add("stress.c", 1.0)
+        for i in range(observes):
+            reg.observe("stress.h", float(i))
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    snap = reg.snapshot()
+    assert snap.counters["stress.c"] == n_threads * adds
+    assert snap.hists["stress.h"]["count"] == n_threads * observes
+
+
+def test_metrics_logger_concurrent_log_no_torn_lines(tmp_path):
+    """8 threads log concurrently into one MetricsLogger (the
+    trainer-thread + batcher-thread sharing pattern): every line parses
+    as JSON, nothing interleaves, close() races are safe."""
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    path = str(tmp_path / "m.jsonl")
+    logger = MetricsLogger(path)
+    n_threads, per_thread = 8, 200
+    barrier = threading.Barrier(n_threads)
+
+    def worker(tid):
+        barrier.wait()
+        for i in range(per_thread):
+            logger.log("stress", {"tid": tid, "i": i, "pad": "x" * 64})
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    logger.close()
+    logger.log("stress", {"late": True})  # after close: dropped, no raise
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    assert len(rows) == n_threads * per_thread
+    seen = {(r["tid"], r["i"]) for r in rows}
+    assert len(seen) == n_threads * per_thread

@@ -1,0 +1,65 @@
+"""Static-analysis pass enforcing the framework's performance and
+thread-safety invariants (ISSUE 3; docs/ANALYSIS.md).
+
+PRs 1–2 made the invariants that keep this trainer/server fast explicit
+— no recompiles under any traffic mix, phase seconds account for
+wall-clock, loader/batcher worker threads never touch shared state
+unlocked — but runtime tests only catch a regression when the exact
+scenario executes.  This package checks the *code* instead: an AST rule
+engine with five JAX-aware rules, runnable as
+
+    python -m xflow_tpu.analysis xflow_tpu/
+
+Rules (each documented in docs/ANALYSIS.md with its rationale and the
+PR-1/PR-2 invariant it guards):
+
+* XF001 recompile hazards — ``jax.jit`` re-created per loop iteration /
+  per call, Python scalars or ``.shape``-derived values flowing into
+  traced positions of a jitted callable;
+* XF002 hidden host syncs — ``float()``/``int()``/``bool()``/
+  ``np.asarray``/``device_get``/``.item()`` inside traced functions,
+  and ``block_until_ready``/``device_get`` in hot-path modules outside
+  an ``obs.phase(...)``/``span(...)`` accounting context;
+* XF003 lock discipline — attributes of lock-owning classes written
+  both inside and outside ``with self._lock``;
+* XF004 schema drift — every JSONL ``kind`` emitted anywhere must be
+  declared in ``obs/schema.py`` and vice versa;
+* XF005 C-ABI parity — ``XF*`` symbols in ``native/include/xflow_tpu.h``
+  vs ``native/src/c_api.cc`` vs ``capi_impl.py``, no orphans.
+
+Suppression: ``# xf: ignore[XF001]`` on the finding line, or
+``# xf: ignore-file[XF001]`` anywhere in the file; a committed baseline
+file (``analysis-baseline.json``) grandfathers legacy findings without
+silencing new ones.
+"""
+
+from __future__ import annotations
+
+from xflow_tpu.analysis.baseline import (
+    load_baseline,
+    split_baselined,
+    write_baseline,
+)
+from xflow_tpu.analysis.core import (
+    Finding,
+    PackageIndex,
+    Rule,
+    SourceFile,
+    all_rules,
+    run_analysis,
+)
+from xflow_tpu.analysis.report import render_json, render_text
+
+__all__ = [
+    "Finding",
+    "PackageIndex",
+    "Rule",
+    "SourceFile",
+    "all_rules",
+    "run_analysis",
+    "load_baseline",
+    "write_baseline",
+    "split_baselined",
+    "render_text",
+    "render_json",
+]

@@ -1,0 +1,105 @@
+"""CLI: ``python -m xflow_tpu.analysis [paths...]``.
+
+Exit codes: 0 — clean (or every finding grandfathered/pragma'd),
+1 — new findings, 2 — usage error.
+
+Examples:
+
+    python -m xflow_tpu.analysis xflow_tpu/
+    python -m xflow_tpu.analysis xflow_tpu/ --format json
+    python -m xflow_tpu.analysis xflow_tpu/serve --select XF003
+    python -m xflow_tpu.analysis xflow_tpu/ --write-baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from xflow_tpu.analysis.baseline import (
+    DEFAULT_BASELINE,
+    load_baseline,
+    split_baselined,
+    write_baseline,
+)
+from xflow_tpu.analysis.core import all_rules, run_analysis
+from xflow_tpu.analysis.report import render_json, render_text
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m xflow_tpu.analysis",
+        description=(
+            "JAX-aware static analysis enforcing xflow-tpu's "
+            "performance and thread-safety invariants (docs/ANALYSIS.md)"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["xflow_tpu"],
+        help="files or directories to scan (default: xflow_tpu)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help=(
+            f"baseline file (default: ./{DEFAULT_BASELINE} when it "
+            "exists)"
+        ),
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="record the current findings as the new baseline and exit 0",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated rule ids to run (e.g. XF001,XF003)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rule ids and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.title}")
+        return 0
+
+    select = (
+        [s.strip() for s in args.select.split(",") if s.strip()]
+        if args.select
+        else None
+    )
+    try:
+        findings, pragma_suppressed = run_analysis(args.paths, select=select)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+
+    if args.write_baseline:
+        out = baseline_path or DEFAULT_BASELINE
+        # carry hand-written justification fields across regeneration
+        write_baseline(out, findings, previous=load_baseline(out))
+        print(f"wrote {len(findings)} finding(s) to {out}")
+        return 0
+
+    entries = load_baseline(baseline_path)
+    new, grandfathered, stale = split_baselined(findings, entries)
+    render = render_json if args.format == "json" else render_text
+    print(render(new, grandfathered, pragma_suppressed, stale))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+"""Committed baseline of grandfathered findings.
+
+The baseline lets the analyzer gate CI from day one without forcing a
+big-bang cleanup: known findings are recorded (rule, path, message —
+no line numbers, so unrelated edits don't invalidate entries) and
+subtracted from the failure set.  Policy (docs/ANALYSIS.md): the
+shipped baseline stays empty or near-empty, every entry carries a
+justification in the file itself, and entries only ever get REMOVED —
+new findings must be fixed or pragma'd with an inline justification.
+
+Regenerate after a deliberate grandfathering decision with:
+
+    python -m xflow_tpu.analysis xflow_tpu/ --write-baseline
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from xflow_tpu.analysis.core import Finding
+
+DEFAULT_BASELINE = "analysis-baseline.json"
+
+
+def load_baseline(path: str | None) -> list[dict]:
+    """Baseline entries ([] when the file doesn't exist)."""
+    if path is None or not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    entries = data.get("findings", [])
+    for e in entries:
+        for field in ("rule", "path", "message"):
+            if field not in e:
+                raise ValueError(
+                    f"{path}: baseline entry missing {field!r}: {e}"
+                )
+    return entries
+
+
+def write_baseline(
+    path: str,
+    findings: list[Finding],
+    previous: list[dict] | None = None,
+) -> None:
+    """Record ``findings`` as the baseline.  Pass the previously loaded
+    entries as ``previous`` so hand-written fields (``justification``)
+    survive regeneration for findings that still match."""
+    carry = {
+        (e["rule"], e["path"], e["message"]): {
+            k: v
+            for k, v in e.items()
+            if k not in ("rule", "path", "message", "line_at_capture")
+        }
+        for e in previous or []
+    }
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "message": f.message,
+            # not used for matching; aids the human reviewing the file
+            "line_at_capture": f.line,
+            **carry.get(f.key(), {}),
+        }
+        for f in findings
+    ]
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "comment": (
+                    "Grandfathered xflow_tpu.analysis findings. Keep "
+                    "this empty or near-empty; justify every entry "
+                    "with a 'justification' field. Matching ignores "
+                    "line numbers (rule+path+message)."
+                ),
+                "findings": entries,
+            },
+            f,
+            indent=2,
+            sort_keys=True,
+        )
+        f.write("\n")
+
+
+def split_baselined(
+    findings: list[Finding], entries: list[dict]
+) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """(new, grandfathered, stale_entries): ``new`` fails the run,
+    ``grandfathered`` matched the baseline, ``stale_entries`` matched
+    nothing (fixed findings whose entries should now be deleted)."""
+    keys = {(e["rule"], e["path"], e["message"]) for e in entries}
+    new = [f for f in findings if f.key() not in keys]
+    grandfathered = [f for f in findings if f.key() in keys]
+    live = {f.key() for f in findings}
+    stale = [
+        e
+        for e in entries
+        if (e["rule"], e["path"], e["message"]) not in live
+    ]
+    return new, grandfathered, stale

@@ -1,0 +1,291 @@
+"""Rule engine: file walking, AST parsing, pragma suppression, and the
+rule registry the five XF rules plug into.
+
+Design constraints:
+
+* pure stdlib ``ast`` — the pass never imports or executes the code
+  under analysis, so it works on files whose imports this environment
+  lacks and needs no functional accelerator backend;
+* cross-file rules — XF004 (schema drift) and XF005 (ABI parity) need
+  the whole scanned tree at once, so rules receive a ``PackageIndex``
+  rather than one file at a time;
+* suppression is data, not control flow — pragmas and the baseline are
+  applied to the collected findings AFTER every rule ran, so reporters
+  can show what was suppressed and a stale pragma/baseline entry is
+  visible instead of silently eating future findings.
+
+Pragma syntax (matched ONLY inside real ``#`` comments, via tokenize —
+prose in docstrings like this one never registers): ``xf: ignore[XF001]``
+suppresses that rule on the comment's line (a comment-ONLY pragma line
+also covers the next line); ``xf: ignore-file[XF001,XF003]`` suppresses
+for the whole file; bare ``xf: ignore`` suppresses every rule on the
+line.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Iterable, Iterator
+
+_PRAGMA_RE = re.compile(
+    r"\bxf:\s*ignore(?P<scope>-file)?(?:\[(?P<rules>[A-Z0-9,\s]+)\])?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site.  ``key()`` (rule, path, message)
+    deliberately excludes the line number so baseline entries survive
+    unrelated edits that shift lines."""
+
+    rule: str
+    path: str  # scan-relative, posix separators
+    line: int
+    message: str
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+class SourceFile:
+    """One parsed python file plus its suppression pragmas."""
+
+    def __init__(self, abspath: str, rel: str, source: str):
+        self.abspath = abspath
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        try:
+            self.tree: ast.Module | None = ast.parse(source)
+        except SyntaxError:
+            self.tree = None
+        self.file_ignores: set[str] = set()
+        self.line_ignores: dict[int, set[str]] = {}
+        # pragmas live in COMMENT tokens only: docstrings or string
+        # literals that merely DESCRIBE the syntax never register
+        try:
+            tokens = list(
+                tokenize.generate_tokens(io.StringIO(source).readline)
+            )
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if m is None:
+                continue
+            rules = m.group("rules")
+            ids = (
+                {r.strip() for r in rules.split(",") if r.strip()}
+                if rules
+                else {"*"}
+            )
+            lineno = tok.start[0]
+            if m.group("scope"):
+                self.file_ignores |= ids
+            else:
+                self.line_ignores.setdefault(lineno, set()).update(ids)
+                if tok.line[: tok.start[1]].strip() == "":
+                    # standalone pragma comment: also covers the
+                    # statement starting on the next line
+                    self.line_ignores.setdefault(
+                        lineno + 1, set()
+                    ).update(ids)
+
+    def suppressed(self, finding: Finding) -> bool:
+        if {"*", finding.rule} & self.file_ignores:
+            return True
+        at_line = self.line_ignores.get(finding.line, set())
+        return bool({"*", finding.rule} & at_line)
+
+
+class PackageIndex:
+    """Every python file under the scanned paths, parsed once, plus the
+    scan roots (XF005 probes them for the non-python ABI files)."""
+
+    def __init__(self, paths: Iterable[str]):
+        self.roots: list[str] = []
+        self.files: list[SourceFile] = []
+        seen: set[str] = set()
+        for path in paths:
+            path = os.path.abspath(path)
+            if os.path.isdir(path):
+                self.roots.append(path)
+                for f in sorted(_walk_py(path)):
+                    self._add(f, os.path.relpath(f, path), seen)
+            elif path.endswith(".py"):
+                self.roots.append(os.path.dirname(path))
+                self._add(path, os.path.basename(path), seen)
+            else:
+                raise FileNotFoundError(
+                    f"not a directory or .py file: {path}"
+                )
+
+    def _add(self, abspath: str, rel: str, seen: set[str]) -> None:
+        if abspath in seen:
+            return
+        seen.add(abspath)
+        with open(abspath, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+        self.files.append(
+            SourceFile(abspath, rel.replace(os.sep, "/"), source)
+        )
+
+    def by_rel(self, suffix: str) -> SourceFile | None:
+        """The file whose scan-relative path ends with ``suffix``."""
+        for f in self.files:
+            if f.rel == suffix or f.rel.endswith("/" + suffix):
+                return f
+        return None
+
+
+def _walk_py(root: str) -> Iterator[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d
+            for d in dirnames
+            if not d.startswith(".") and d != "__pycache__"
+        ]
+        for name in filenames:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``title`` and implement
+    ``run(index)``.  Instantiating registers nothing — the registry is
+    the explicit ``all_rules()`` list so test fixtures can run subsets."""
+
+    id: str = "XF000"
+    title: str = ""
+
+    def run(self, index: PackageIndex) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, sf: SourceFile, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=sf.rel,
+            line=getattr(node, "lineno", 0),
+            message=message,
+        )
+
+
+def all_rules() -> list[Rule]:
+    from xflow_tpu.analysis.rules_abi import CAbiParity
+    from xflow_tpu.analysis.rules_jax import HiddenHostSyncs, RecompileHazards
+    from xflow_tpu.analysis.rules_schema import SchemaDrift
+    from xflow_tpu.analysis.rules_threads import LockDiscipline
+
+    return [
+        RecompileHazards(),
+        HiddenHostSyncs(),
+        LockDiscipline(),
+        SchemaDrift(),
+        CAbiParity(),
+    ]
+
+
+def run_analysis(
+    paths: Iterable[str],
+    rules: Iterable[Rule] | None = None,
+    select: Iterable[str] | None = None,
+) -> tuple[list[Finding], list[Finding]]:
+    """Run the rule set over ``paths``.
+
+    Returns ``(findings, pragma_suppressed)`` — baseline filtering is a
+    separate step (baseline.split_baselined) so callers can report the
+    grandfathered set.
+    """
+    index = PackageIndex(paths)
+    rule_list = list(rules) if rules is not None else all_rules()
+    if select is not None:
+        wanted = set(select)
+        unknown = wanted - {r.id for r in rule_list}
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+        rule_list = [r for r in rule_list if r.id in wanted]
+    by_rel = {f.rel: f for f in index.files}
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    seen: set[tuple] = set()
+    for rule in rule_list:
+        for finding in rule.run(index):
+            # dedupe: e.g. a jit inside nested loops matches the
+            # loop-body scan once per enclosing loop
+            dupkey = (finding.rule, finding.path, finding.line,
+                      finding.message)
+            if dupkey in seen:
+                continue
+            seen.add(dupkey)
+            sf = by_rel.get(finding.path)
+            if sf is not None and sf.suppressed(finding):
+                suppressed.append(finding)
+            else:
+                active.append(finding)
+    order = {r.id: i for i, r in enumerate(rule_list)}
+    active.sort(key=lambda f: (f.path, f.line, order.get(f.rule, 99)))
+    suppressed.sort(key=lambda f: (f.path, f.line))
+    return active, suppressed
+
+
+# -- shared AST helpers (used by several rules) ---------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); None when the
+    expression isn't a plain dotted path."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jit_callable(node: ast.AST) -> bool:
+    """Does this expression name jax's jit entry point?  Accepts
+    ``jax.jit``, ``jit``, ``pjit``, ``jax.experimental.pjit.pjit`` —
+    anything whose dotted path ends in jit/pjit."""
+    name = dotted_name(node)
+    if name is None:
+        return False
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf in ("jit", "pjit")
+
+
+def jit_call(node: ast.AST) -> ast.Call | None:
+    """The ``jax.jit(...)`` Call when ``node`` is one, else None."""
+    if isinstance(node, ast.Call) and is_jit_callable(node.func):
+        return node
+    return None
+
+
+def walk_scoped(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does NOT descend into nested function/class
+    definitions — the body of a nested def is its own scope."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(
+            child,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda),
+        ):
+            stack.extend(ast.iter_child_nodes(child))

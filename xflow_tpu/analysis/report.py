@@ -1,0 +1,66 @@
+"""Finding reporters: human text and machine JSON (--format json is
+the contract future dashboards consume — stable keys, no prose-only
+information)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from xflow_tpu.analysis.core import Finding
+
+
+def render_text(
+    new: list[Finding],
+    grandfathered: list[Finding],
+    pragma_suppressed: list[Finding],
+    stale_baseline: list[dict],
+) -> str:
+    lines: list[str] = []
+    for f in new:
+        lines.append(f"{f.path}:{f.line}: {f.rule} {f.message}")
+    if grandfathered:
+        lines.append(
+            f"note: {len(grandfathered)} finding(s) grandfathered by "
+            "the baseline"
+        )
+    if pragma_suppressed:
+        lines.append(
+            f"note: {len(pragma_suppressed)} finding(s) suppressed by "
+            "xf: ignore pragmas"
+        )
+    for e in stale_baseline:
+        lines.append(
+            f"note: stale baseline entry no longer matches anything: "
+            f"{e['rule']} {e['path']}: {e['message'][:60]}... — delete it"
+        )
+    if new:
+        lines.append(f"FAIL: {len(new)} new finding(s)")
+    else:
+        lines.append("OK: no new findings")
+    return "\n".join(lines)
+
+
+def render_json(
+    new: list[Finding],
+    grandfathered: list[Finding],
+    pragma_suppressed: list[Finding],
+    stale_baseline: list[dict],
+) -> str:
+    by_rule: dict[str, int] = {}
+    for f in new:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    doc: dict[str, Any] = {
+        "ok": not new,
+        "counts": {
+            "new": len(new),
+            "grandfathered": len(grandfathered),
+            "pragma_suppressed": len(pragma_suppressed),
+            "stale_baseline": len(stale_baseline),
+            "by_rule": by_rule,
+        },
+        "findings": [f.to_dict() for f in new],
+        "grandfathered": [f.to_dict() for f in grandfathered],
+        "stale_baseline": stale_baseline,
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

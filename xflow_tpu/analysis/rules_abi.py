@@ -1,0 +1,142 @@
+"""XF005 — C-ABI parity across the three declaration surfaces.
+
+The embed surface spans three files that nothing compiles together in
+CI: ``native/include/xflow_tpu.h`` (what C callers see),
+``native/src/c_api.cc`` (the embedding shims), and ``capi_impl.py``
+(the Python functions the shims call via ``call_impl("name")``).  The
+.so ships prebuilt, so a symbol added to one surface and forgotten in
+another only explodes at customer link/run time.  This rule diffs all
+three statically:
+
+* every ``XF*`` function declared in the header is defined in c_api.cc
+  and vice versa (no orphan definitions);
+* every ``call_impl("name")`` target in c_api.cc exists as a function
+  in capi_impl.py;
+* every public function in capi_impl.py is reachable from c_api.cc
+  (the module exists solely as the ABI's Python half).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from xflow_tpu.analysis.core import Finding, PackageIndex, Rule
+
+_HEADER_REL = os.path.join("native", "include", "xflow_tpu.h")
+_CC_REL = os.path.join("native", "src", "c_api.cc")
+
+_XF_FN_RE = re.compile(r"\b(XF[A-Za-z0-9_]+)\s*\(")
+_CALL_IMPL_RE = re.compile(r"call_impl\(\s*\"(\w+)\"")
+_BLOCK_COMMENT_RE = re.compile(r"/\*.*?\*/", re.S)
+_LINE_COMMENT_RE = re.compile(r"//[^\n]*")
+
+
+def _strip_c_comments(text: str) -> str:
+    """Blank out comments, preserving newlines so line numbers hold."""
+
+    def blank(m: re.Match) -> str:
+        return re.sub(r"[^\n]", " ", m.group(0))
+
+    return _LINE_COMMENT_RE.sub(blank, _BLOCK_COMMENT_RE.sub(blank, text))
+
+
+def _xf_symbols(text: str) -> dict[str, int]:
+    """XF function name -> first line it appears at (comments stripped)."""
+    stripped = _strip_c_comments(text)
+    out: dict[str, int] = {}
+    for m in _XF_FN_RE.finditer(stripped):
+        name = m.group(1)
+        if name not in out:
+            out[name] = stripped.count("\n", 0, m.start()) + 1
+    return out
+
+
+class CAbiParity(Rule):
+    id = "XF005"
+    title = "C-ABI symbol parity (header / c_api.cc / capi_impl.py)"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        header_path = cc_path = None
+        for root in index.roots:
+            h = os.path.join(root, _HEADER_REL)
+            c = os.path.join(root, _CC_REL)
+            if header_path is None and os.path.exists(h):
+                header_path = h
+            if cc_path is None and os.path.exists(c):
+                cc_path = c
+        if header_path is None or cc_path is None:
+            return  # no native surface in this scan
+        with open(header_path, encoding="utf-8", errors="replace") as f:
+            header_text = f.read()
+        with open(cc_path, encoding="utf-8", errors="replace") as f:
+            cc_text = f.read()
+        declared = _xf_symbols(header_text)
+        defined = _xf_symbols(cc_text)
+        header_rel = _HEADER_REL.replace(os.sep, "/")
+        cc_rel = _CC_REL.replace(os.sep, "/")
+        for name, line in sorted(declared.items()):
+            if name not in defined:
+                yield Finding(
+                    rule=self.id,
+                    path=header_rel,
+                    line=line,
+                    message=(
+                        f"{name} is declared in the header but has no "
+                        "definition in c_api.cc — C callers link "
+                        "against a symbol that does not exist"
+                    ),
+                )
+        for name, line in sorted(defined.items()):
+            if name not in declared:
+                yield Finding(
+                    rule=self.id,
+                    path=cc_rel,
+                    line=line,
+                    message=(
+                        f"{name} is defined in c_api.cc but not "
+                        "declared in the header — unreachable ABI "
+                        "surface; declare it or delete it"
+                    ),
+                )
+        # -- python half ------------------------------------------------
+        capi = index.by_rel("capi_impl.py")
+        if capi is None or capi.tree is None:
+            return
+        impl_fns = {
+            node.name: node.lineno
+            for node in capi.tree.body
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        stripped_cc = _strip_c_comments(cc_text)
+        called: dict[str, int] = {}
+        for m in _CALL_IMPL_RE.finditer(stripped_cc):
+            called.setdefault(
+                m.group(1), stripped_cc.count("\n", 0, m.start()) + 1
+            )
+        for name, line in sorted(called.items()):
+            if name not in impl_fns:
+                yield Finding(
+                    rule=self.id,
+                    path=cc_rel,
+                    line=line,
+                    message=(
+                        f"c_api.cc calls capi_impl.{name} which does "
+                        "not exist — the ABI entry fails at runtime "
+                        "with an AttributeError through XFLastError"
+                    ),
+                )
+        for name, line in sorted(impl_fns.items()):
+            if not name.startswith("_") and name not in called:
+                yield Finding(
+                    rule=self.id,
+                    path=capi.rel,
+                    line=line,
+                    message=(
+                        f"capi_impl.{name} is public but no c_api.cc "
+                        "shim calls it — orphan ABI half; wire it into "
+                        "c_api.cc + the header or prefix it with _"
+                    ),
+                )

@@ -1,0 +1,437 @@
+"""XF001 (recompile hazards) and XF002 (hidden host syncs).
+
+These guard the two PR-2 serving/trainer invariants that die silently:
+the no-recompile guarantee (PredictEngine buckets + one jit per
+TrainStep — docs/SERVING.md) and the phase-accounting contract (every
+host sync is booked under an obs phase so exclusive phases cover >= 90%
+of wall-clock — docs/OBSERVABILITY.md, scripts/check_metrics_schema.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from xflow_tpu.analysis.core import (
+    Finding,
+    PackageIndex,
+    Rule,
+    SourceFile,
+    dotted_name,
+    jit_call,
+    walk_scoped,
+)
+
+_STATIC_KWARGS = ("static_argnums", "static_argnames")
+
+
+def _jit_has_static(call: ast.Call) -> bool:
+    return any(kw.arg in _STATIC_KWARGS for kw in call.keywords)
+
+
+def _contains_shape(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            return True
+    return False
+
+
+def _is_partial_of_jit(call: ast.Call) -> bool:
+    """functools.partial(jax.jit, ...) — the decorator-with-options
+    idiom; partial's keywords are jit's keywords."""
+    name = dotted_name(call.func)
+    if name is None or name.rsplit(".", 1)[-1] != "partial" or not call.args:
+        return False
+    first = dotted_name(call.args[0])
+    return first is not None and first.rsplit(".", 1)[-1] in ("jit", "pjit")
+
+
+class RecompileHazards(Rule):
+    id = "XF001"
+    title = "jax.jit recompile hazards"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            yield from self._check_file(sf)
+
+    def _check_file(self, sf: SourceFile) -> Iterator[Finding]:
+        tree = sf.tree
+        assert tree is not None
+        # (a) jit created inside a loop body: rebuilt — and retraced —
+        # every iteration (the jit cache is keyed by function object).
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.While)):
+                for sub in walk_scoped(node):
+                    call = jit_call(sub)
+                    if call is not None:
+                        yield self.finding(
+                            sf,
+                            call,
+                            "jax.jit created inside a loop — the "
+                            "compilation cache is keyed by function "
+                            "object, so every iteration rebuilds and "
+                            "retraces it; hoist the jit out of the "
+                            "loop or cache the compiled executable",
+                        )
+        # (b) jax.jit(f)(args): a fresh traced callable per call —
+        # nothing is ever cached.  (jax.jit(f).lower().compile() is the
+        # sanctioned AOT idiom, serve/engine.py, and does not match.)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and jit_call(node.func) is not None:
+                yield self.finding(
+                    sf,
+                    node,
+                    "jax.jit(...) invoked immediately — a fresh jitted "
+                    "callable per call defeats the compilation cache; "
+                    "bind the jitted function once (TrainStep.__init__ "
+                    "idiom) or AOT-compile via .lower(...).compile()",
+                )
+        yield from self._check_call_sites(sf, tree)
+
+    def _check_call_sites(
+        self, sf: SourceFile, tree: ast.Module
+    ) -> Iterator[Finding]:
+        # (c) names bound to jitted callables, then call sites feeding
+        # them Python scalar literals or .shape-derived expressions.
+        jitted_names: dict[str, bool] = {}  # name -> has static args
+        jitted_attrs: dict[str, bool] = {}  # self.<attr> -> has static
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                call = jit_call(node.value)
+                if call is None:
+                    continue
+                static = _jit_has_static(call)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        jitted_names[tgt.id] = static
+                    elif (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        jitted_attrs[tgt.attr] = static
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if jit_call(dec) is not None:
+                        jitted_names[node.name] = _jit_has_static(dec)
+                    elif dotted_name(dec) is not None and dotted_name(
+                        dec
+                    ).rsplit(".", 1)[-1] in ("jit", "pjit"):
+                        jitted_names[node.name] = False
+                    elif isinstance(dec, ast.Call) and _is_partial_of_jit(
+                        dec
+                    ):
+                        jitted_names[node.name] = _jit_has_static(dec)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in jitted_names:
+                name, static = func.id, jitted_names[func.id]
+            elif (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and func.attr in jitted_attrs
+            ):
+                name, static = func.attr, jitted_attrs[func.attr]
+            else:
+                continue
+            if static:
+                # static_argnums/argnames declared: scalar args are the
+                # INTENDED compile-time keys, not an accident
+                continue
+            for i, arg in enumerate(node.args):
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, (int, float)
+                ):
+                    yield self.finding(
+                        sf,
+                        arg,
+                        f"Python scalar literal in traced position {i} "
+                        f"of jitted {name!r} — weak-typed scalars split "
+                        "the jit cache and silently promote dtypes; "
+                        "pass a jnp array or declare the arg in "
+                        "static_argnums",
+                    )
+                elif _contains_shape(arg):
+                    yield self.finding(
+                        sf,
+                        arg,
+                        f".shape-derived value in traced position {i} "
+                        f"of jitted {name!r} — every distinct shape "
+                        "retraces; route it through static_argnums or "
+                        "snap to fixed buckets (serve/engine.py idiom)",
+                    )
+
+
+# -- XF002 ----------------------------------------------------------------
+
+_HOST_CONVERSIONS = ("float", "int", "bool")
+_NP_SYNC_LEAVES = ("asarray", "array")
+_SYNC_METHOD_ATTRS = ("item", "tolist")
+# modules where an unbooked sync breaks the phase-accounting invariant
+_HOT_PATH_PREFIXES = ("parallel/", "serve/", "io/", "ops/")
+_HOT_PATH_FILES = ("trainer.py",)
+
+
+def _is_hot_path(rel: str) -> bool:
+    if rel in _HOT_PATH_FILES or any(
+        rel.endswith("/" + f) for f in _HOT_PATH_FILES
+    ):
+        return True
+    return any(
+        rel.startswith(p) or ("/" + p) in rel for p in _HOT_PATH_PREFIXES
+    )
+
+
+class _FnInfo:
+    __slots__ = ("node", "cls", "parent")
+
+    def __init__(self, node, cls, parent):
+        self.node = node  # FunctionDef
+        self.cls = cls  # enclosing class name or None
+        self.parent = parent  # enclosing _FnInfo or None
+
+
+def _collect_functions(tree: ast.Module) -> list[_FnInfo]:
+    out: list[_FnInfo] = []
+
+    def visit(node: ast.AST, cls: str | None, parent: _FnInfo | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _FnInfo(child, cls, parent)
+                out.append(info)
+                visit(child, cls, info)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, child.name, None)
+            else:
+                visit(child, cls, parent)
+
+    visit(tree, None, None)
+    return out
+
+
+class HiddenHostSyncs(Rule):
+    id = "XF002"
+    title = "hidden host syncs"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            yield from self._check_traced(sf)
+            if _is_hot_path(sf.rel):
+                yield from self._check_spans(sf)
+
+    # -- traced-function scope (ConcretizationError / silent sync) -----
+
+    def _traced_functions(self, sf: SourceFile) -> list[_FnInfo]:
+        tree = sf.tree
+        assert tree is not None
+        fns = _collect_functions(tree)
+        traced: set[int] = set()
+
+        def seed(info: _FnInfo) -> bool:
+            for dec in info.node.decorator_list:
+                name = dotted_name(dec)
+                if name is not None and name.rsplit(".", 1)[-1] in (
+                    "jit",
+                    "pjit",
+                ):
+                    return True
+                if isinstance(dec, ast.Call):
+                    if jit_call(dec) is not None or _is_partial_of_jit(dec):
+                        return True
+            return False
+
+        # seeds: @jit decorations plus any function passed to jax.jit
+        # by name (f, self.f) anywhere in the module
+        jit_targets_names: set[str] = set()
+        jit_targets_methods: set[str] = set()
+        for node in ast.walk(tree):
+            call = jit_call(node)
+            if call is None or not call.args:
+                continue
+            arg = call.args[0]
+            if isinstance(arg, ast.Name):
+                jit_targets_names.add(arg.id)
+            elif (
+                isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "self"
+            ):
+                jit_targets_methods.add(arg.attr)
+        for info in fns:
+            if seed(info):
+                traced.add(id(info))
+            elif info.cls is None and info.node.name in jit_targets_names:
+                traced.add(id(info))
+            elif info.cls is not None and (
+                info.node.name in jit_targets_methods
+            ):
+                traced.add(id(info))
+        # closure: callees of traced functions (same module) are traced,
+        # and so is any function DEFINED inside a traced one (lax.scan
+        # bodies are called by reference, not by name)
+        by_name_module = {
+            info.node.name: info for info in fns if info.cls is None
+        }
+        by_method: dict[tuple[str, str], _FnInfo] = {
+            (info.cls, info.node.name): info
+            for info in fns
+            if info.cls is not None
+        }
+        changed = True
+        while changed:
+            changed = False
+            for info in fns:
+                if id(info) in traced:
+                    continue
+                if info.parent is not None and id(info.parent) in traced:
+                    traced.add(id(info))
+                    changed = True
+            for info in fns:
+                if id(info) not in traced:
+                    continue
+                for node in walk_scoped(info.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee: _FnInfo | None = None
+                    if isinstance(node.func, ast.Name):
+                        callee = by_name_module.get(node.func.id)
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and info.cls is not None
+                    ):
+                        callee = by_method.get((info.cls, node.func.attr))
+                    if callee is not None and id(callee) not in traced:
+                        traced.add(id(callee))
+                        changed = True
+        return [info for info in fns if id(info) in traced]
+
+    def _check_traced(self, sf: SourceFile) -> Iterator[Finding]:
+        for info in self._traced_functions(sf):
+            fname = info.node.name
+            for node in walk_scoped(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if (
+                    isinstance(func, ast.Name)
+                    and func.id in _HOST_CONVERSIONS
+                    and node.args
+                    and not isinstance(node.args[0], ast.Constant)
+                ):
+                    yield self.finding(
+                        sf,
+                        node,
+                        f"{func.id}() inside traced function "
+                        f"{fname!r} — host conversion of a traced "
+                        "value is a device sync (or a Concretization"
+                        "Error); keep reductions in jnp and convert "
+                        "after device_get",
+                    )
+                    continue
+                name = dotted_name(func)
+                leaf = name.rsplit(".", 1)[-1] if name else (
+                    func.attr if isinstance(func, ast.Attribute) else None
+                )
+                if leaf is None:
+                    continue
+                if name is not None and name.split(".", 1)[0] in (
+                    "np",
+                    "numpy",
+                ) and leaf in _NP_SYNC_LEAVES:
+                    yield self.finding(
+                        sf,
+                        node,
+                        f"numpy {leaf}() inside traced function "
+                        f"{fname!r} — materializes the traced value on "
+                        "host every call; use jnp or move it outside "
+                        "the jitted step",
+                    )
+                elif leaf == "device_get":
+                    yield self.finding(
+                        sf,
+                        node,
+                        f"jax.device_get inside traced function "
+                        f"{fname!r} — a host round-trip inside the "
+                        "compiled step; fetch results after dispatch",
+                    )
+                elif leaf == "block_until_ready":
+                    yield self.finding(
+                        sf,
+                        node,
+                        f"block_until_ready inside traced function "
+                        f"{fname!r} — blocking has no meaning under "
+                        "tracing and signals host/device confusion",
+                    )
+                elif (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _SYNC_METHOD_ATTRS
+                    and not node.args
+                ):
+                    yield self.finding(
+                        sf,
+                        node,
+                        f".{func.attr}() inside traced function "
+                        f"{fname!r} — host conversion of a traced "
+                        "value; return the array and convert outside",
+                    )
+
+    # -- hot-path span accounting (blocking outside obs phases) ---------
+
+    def _check_spans(self, sf: SourceFile) -> Iterator[Finding]:
+        tree = sf.tree
+        assert tree is not None
+        findings: list[Finding] = []
+
+        def span_item(item: ast.withitem) -> bool:
+            call = item.context_expr
+            return (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("phase", "span")
+            )
+
+        def visit(node: ast.AST, in_span: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_span = in_span
+                if isinstance(child, ast.With):
+                    child_span = in_span or any(
+                        span_item(i) for i in child.items
+                    )
+                if isinstance(child, ast.Call) and not in_span:
+                    name = dotted_name(child.func)
+                    leaf = (
+                        name.rsplit(".", 1)[-1]
+                        if name
+                        else (
+                            child.func.attr
+                            if isinstance(child.func, ast.Attribute)
+                            else None
+                        )
+                    )
+                    if leaf in ("block_until_ready", "device_get"):
+                        findings.append(
+                            self.finding(
+                                sf,
+                                child,
+                                f"{leaf} outside an obs phase/span "
+                                "context in a hot-path module — the "
+                                "blocked seconds vanish from phase "
+                                "accounting (the >=90% wall-clock "
+                                "coverage invariant, scripts/"
+                                "check_metrics_schema.py); wrap it in "
+                                "`with obs.phase(...)`",
+                            )
+                        )
+                visit(child, child_span)
+
+        visit(tree, False)
+        yield from findings

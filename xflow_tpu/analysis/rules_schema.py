@@ -1,0 +1,107 @@
+"""XF004 — metrics JSONL schema drift.
+
+obs/schema.py is the single source of truth for every ``kind`` the
+framework emits (PR 1), and the runtime lints
+(scripts/check_metrics_schema.py, check_serve_smoke.py) only validate
+kinds the toy pipelines happen to emit.  This rule closes the gap
+statically: every string-literal ``kind`` passed to a ``.log(...)``
+call anywhere in the scanned tree must be declared in the SCHEMA dict,
+and — on whole-package scans — every declared kind must be emitted
+somewhere, so dead schema entries can't accumulate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from xflow_tpu.analysis.core import (
+    Finding,
+    PackageIndex,
+    Rule,
+    SourceFile,
+)
+
+
+def _schema_kinds(sf: SourceFile) -> dict[str, int] | None:
+    """kind -> declaration line from a module-level ``SCHEMA = {...}``."""
+    if sf.tree is None:
+        return None
+    for node in sf.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "SCHEMA"
+            for t in node.targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        return {
+            k.value: k.lineno
+            for k in node.value.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        }
+    return None
+
+
+class SchemaDrift(Rule):
+    id = "XF004"
+    title = "emitted JSONL kind not declared in obs/schema.py (or vice versa)"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        schema_file = None
+        kinds: dict[str, int] | None = None
+        for sf in index.files:
+            if sf.rel.endswith("schema.py"):
+                kinds = _schema_kinds(sf)
+                if kinds is not None:
+                    schema_file = sf
+                    break
+        if schema_file is None or kinds is None:
+            return  # nothing to check against (partial scan)
+        emitted: dict[str, list[tuple[SourceFile, ast.AST]]] = {}
+        for sf in index.files:
+            if sf is schema_file or sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "log"
+                    and len(node.args) >= 2
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    emitted.setdefault(node.args[0].value, []).append(
+                        (sf, node)
+                    )
+        for kind, sites in sorted(emitted.items()):
+            if kind not in kinds:
+                for sf, node in sites:
+                    yield self.finding(
+                        sf,
+                        node,
+                        f"JSONL kind {kind!r} is emitted here but not "
+                        f"declared in {schema_file.rel} SCHEMA — "
+                        "consumers (obs validate/summarize, the CI "
+                        "lints) will reject the file; declare the "
+                        "kind's fields first",
+                    )
+        # The vice-versa direction only makes sense when the scan covers
+        # the emitting side of the package, not just a subtree: use the
+        # trainer (the primary emitter) as the whole-package sentinel.
+        if index.by_rel("trainer.py") is None:
+            return
+        for kind, lineno in sorted(kinds.items()):
+            if kind not in emitted:
+                yield Finding(
+                    rule=self.id,
+                    path=schema_file.rel,
+                    line=lineno,
+                    message=(
+                        f"SCHEMA declares kind {kind!r} but nothing in "
+                        "the scanned tree emits it — dead schema "
+                        "entries hide real drift; delete it or emit it"
+                    ),
+                )

@@ -1,0 +1,160 @@
+"""XF003 — lock discipline for classes that own a threading.Lock.
+
+The overlap machinery (io/loader.py prefetch + parse pool,
+serve/batcher.py worker thread, obs/registry.py metric mutations from
+every thread) only stays correct because shared attributes are mutated
+under the owning object's lock.  A mutation added outside ``with
+self._lock`` compiles, passes single-threaded tests, and then tears
+state under real concurrency — exactly the class of bug a runtime test
+suite is worst at catching.
+
+The rule: for every class that assigns a ``threading.Lock``/``RLock``
+to a ``self.*`` attribute, any OTHER ``self.*`` attribute that is
+written under a lock somewhere must be written under a lock everywhere
+(``__init__`` is exempt — the object is not yet shared during
+construction).  Subscript stores (``self._counters[k] = v``) count as
+writes to the attribute.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterator
+
+from xflow_tpu.analysis.core import (
+    Finding,
+    PackageIndex,
+    Rule,
+    SourceFile,
+    dotted_name,
+)
+
+_CONSTRUCTOR_METHODS = ("__init__", "__new__")
+
+
+def _lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return name is not None and name.rsplit(".", 1)[-1] in ("Lock", "RLock")
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' for ``self.x``; also resolves ``self.x[k]`` to 'x'."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+@dataclass
+class _Write:
+    attr: str
+    method: str
+    guarded: bool
+    node: ast.AST
+
+
+class LockDiscipline(Rule):
+    id = "XF003"
+    title = "unlocked mutation of lock-guarded state"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    yield from self._check_class(sf, node)
+
+    def _check_class(
+        self, sf: SourceFile, cls: ast.ClassDef
+    ) -> Iterator[Finding]:
+        locks = {
+            attr
+            for node in ast.walk(cls)
+            if isinstance(node, ast.Assign) and _lock_ctor(node.value)
+            for tgt in node.targets
+            if (attr := _self_attr(tgt)) is not None
+        }
+        if not locks:
+            return
+        writes: list[_Write] = []
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_writes(item, locks, writes)
+        guarded_attrs = {w.attr for w in writes if w.guarded}
+        guard_example = {
+            w.attr: w for w in reversed(writes) if w.guarded
+        }
+        lock_name = sorted(locks)[0]
+        for w in writes:
+            if (
+                not w.guarded
+                and w.attr in guarded_attrs
+                and w.method not in _CONSTRUCTOR_METHODS
+            ):
+                g = guard_example[w.attr]
+                # no line numbers in the message: baseline matching is
+                # (rule, path, message) and must survive line drift
+                yield self.finding(
+                    sf,
+                    w.node,
+                    f"self.{w.attr} of {cls.name} is written in "
+                    f"{w.method}() without the lock but under `with "
+                    f"self.{lock_name}` in {g.method}() — an unlocked "
+                    "mutation of shared state races with worker "
+                    "threads",
+                )
+
+    def _collect_writes(
+        self,
+        method: ast.FunctionDef | ast.AsyncFunctionDef,
+        locks: set[str],
+        out: list[_Write],
+    ) -> None:
+        def lock_item(item: ast.withitem) -> bool:
+            return _self_attr(item.context_expr) in locks
+
+        def visit(node: ast.AST, guarded: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_guarded = guarded
+                if isinstance(child, ast.With):
+                    child_guarded = guarded or any(
+                        lock_item(i) for i in child.items
+                    )
+                if isinstance(child, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = (
+                        child.targets
+                        if isinstance(child, ast.Assign)
+                        else [child.target]
+                    )
+                    for tgt in targets:
+                        for leaf in self._flatten(tgt):
+                            attr = _self_attr(leaf)
+                            if attr is not None and attr not in locks:
+                                out.append(
+                                    _Write(
+                                        attr,
+                                        method.name,
+                                        child_guarded,
+                                        leaf,
+                                    )
+                                )
+                visit(child, child_guarded)
+
+        visit(method, False)
+
+    @staticmethod
+    def _flatten(target: ast.AST) -> Iterator[ast.AST]:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                yield from LockDiscipline._flatten(elt)
+        else:
+            yield target

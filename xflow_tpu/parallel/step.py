@@ -59,6 +59,8 @@ def init_state(model: Model, optimizer: Optimizer, cfg: Config, mesh) -> State:
     tables: dict[str, dict[str, jax.Array]] = {}
     for i, spec in enumerate(model.tables()):
         shape = (cfg.table_size, spec.dim)
+        # once-per-Trainer-construction table init, one jit per table
+        # spec by design — not a hot-loop retrace (xf: ignore[XF001])
         init_fn = jax.jit(
             functools.partial(spec.init, shape=shape), out_shardings=sharding
         )

@@ -52,9 +52,15 @@ def export_artifact(trainer, directory: str) -> str:
 
     Multi-host: COLLECTIVE — all processes call together; each writes
     its own table row ranges (module docstring)."""
+    from xflow_tpu.obs import NULL_OBS
+
     state = trainer.state
     cfg = trainer.cfg
-    step = int(jax.device_get(state["step"]))
+    # book the export's device fetches as an obs phase so a slow export
+    # shows up in phase accounting instead of vanishing (XF002)
+    obs = getattr(trainer, "obs", None) or NULL_OBS
+    with obs.phase("export_fetch"):
+        step = int(jax.device_get(state["step"]))
     proc = jax.process_index()
     parent = os.path.dirname(os.path.abspath(directory))
     tmp = os.path.join(
@@ -91,9 +97,12 @@ def export_artifact(trainer, directory: str) -> str:
                 )
         if proc == 0:
             for dname in sorted(state.get("dense", {})):
+                with obs.phase("export_fetch"):
+                    host_dense = np.asarray(
+                        jax.device_get(state["dense"][dname])
+                    )
                 np.save(
-                    os.path.join(tmp, f"dense.{dname}.npy"),
-                    np.asarray(jax.device_get(state["dense"][dname])),
+                    os.path.join(tmp, f"dense.{dname}.npy"), host_dense
                 )
             if trainer.remap is not None:
                 np.save(os.path.join(tmp, REMAP_FILE), trainer.remap)

@@ -66,6 +66,7 @@ class MicroBatcher:
         self._submit_lock = threading.Lock()
         self._closed = False
         self._final_stats: dict | None = None
+        self._drained = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="xflow-serve-batcher", daemon=True
         )
@@ -140,16 +141,27 @@ class MicroBatcher:
 
     def close(self) -> dict:
         """Drain the queue, stop the worker, flush ONE final
-        ``serve_stats`` row; returns it.  Idempotent: later calls
-        return the same row without logging again."""
+        ``serve_stats`` row; returns it.  Idempotent AND thread-safe:
+        concurrent/later closers block on the drain event until the
+        first closer has published the final row, so every caller gets
+        the same stats (a bare ``first`` flag would let a second closer
+        read ``_final_stats`` before the first finished joining)."""
         with self._submit_lock:
             first = not self._closed
             if first:
                 self._closed = True
                 self._q.put(_STOP)
         if first:
-            self._thread.join()
-            self._final_stats = self.emit_stats()
+            try:
+                self._thread.join()
+                self._final_stats = self.emit_stats()
+            finally:
+                # set even on failure: a raising first closer must not
+                # leave concurrent closers blocked forever (they fail
+                # the assert below instead)
+                self._drained.set()
+        else:
+            self._drained.wait()
         assert self._final_stats is not None
         return self._final_stats
 

@@ -423,6 +423,8 @@ class Trainer:
         a step metric first the trace would close before the profiled
         steps' device work ran."""
         if flush_metric is not None:
+            # this block IS the flush-then-stop invariant: it must run
+            # unconditionally, span or no span (xf: ignore[XF002])
             jax.device_get(flush_metric["logloss"])  # flush pending work
         jax.profiler.stop_trace()
         self._profiled = True

@@ -16,12 +16,21 @@ full record schema lives in obs/schema.py (docs/OBSERVABILITY.md).
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import Any, IO
 
 
 class MetricsLogger:
+    """Thread-safe: the trainer logs from the main thread while a
+    MicroBatcher flushes serve_stats from its worker thread into the
+    same file — one lock serializes the closed-check + write so lines
+    never interleave and a log racing close() can't hit a closed file
+    (XF003 discipline: every ``closed``/file mutation under ``_lock``).
+    """
+
     def __init__(self, path: str, run_header: dict[str, Any] | None = None):
+        self._lock = threading.Lock()
         self._f: IO[str] = open(path, "a", buffering=1)
         self._t0 = time.time()
         self.closed = False
@@ -31,16 +40,19 @@ class MetricsLogger:
             self.log("run_start", header)
 
     def log(self, kind: str, record: dict[str, Any]) -> None:
-        if self.closed:  # late log after a preemption/exception close
-            return
         row = {"t": round(time.time() - self._t0, 3), "kind": kind}
         row.update(record)
-        self._f.write(json.dumps(row, sort_keys=True) + "\n")
+        line = json.dumps(row, sort_keys=True) + "\n"
+        with self._lock:
+            if self.closed:  # late log after a preemption/exception close
+                return
+            self._f.write(line)
 
     def close(self) -> None:
-        if not self.closed:
-            self.closed = True
-            self._f.close()
+        with self._lock:
+            if not self.closed:
+                self.closed = True
+                self._f.close()
 
     def __enter__(self) -> "MetricsLogger":
         return self
