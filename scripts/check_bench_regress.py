@@ -1,0 +1,91 @@
+"""Bench-trajectory regress check: compare the newest committed
+``BENCH_r*.json`` against the best prior run via ``python -m
+xflow_tpu.obs compare --fail-on-regress``.
+
+The committed bench artifacts accumulated for five PRs without ever
+gating anything; this script turns the trajectory into a signal.  It
+is WARN-ONLY by default (exit 0 with a loud message): the containers
+the tier-1 suite runs in are routinely degraded (CPU backend,
+``degraded: true`` in the artifact), so a hard gate would fail on
+environment, not on code.  ``--strict`` makes a regression (or a
+missing baseline) exit non-zero for environments where the numbers are
+trustworthy.
+
+Run from the repo root:
+
+    python scripts/check_bench_regress.py [--frac 0.10] [--strict]
+
+Wired into tier-1 (warn-only) via tests/test_observability.py::
+test_check_bench_regress_script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def find_bench_artifacts(root: str) -> list[str]:
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument(
+        "--frac", type=float, default=0.10,
+        help="fail threshold: fraction below the best prior run "
+        "(default 0.10 = 10%%)",
+    )
+    p.add_argument(
+        "--strict", action="store_true",
+        help="exit non-zero on regression (default: warn only — "
+        "tier-1 containers produce degraded numbers)",
+    )
+    p.add_argument("--root", default=REPO, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    from xflow_tpu.obs.__main__ import main as obs_main
+    from xflow_tpu.obs.summary import load_bench_result
+
+    paths = find_bench_artifacts(args.root)
+    usable = [p_ for p_ in paths if load_bench_result(p_) is not None]
+    if len(usable) < 2:
+        print(
+            f"SKIP: {len(usable)} usable bench artifact(s) under "
+            f"{args.root} — need a latest and at least one prior"
+        )
+        return 1 if args.strict else 0
+    latest = usable[-1]
+    best_prior = max(
+        usable[:-1],
+        key=lambda p_: float(load_bench_result(p_)["value"]),
+    )
+    print(f"comparing latest {latest} against best prior {best_prior}:")
+    rc = obs_main([
+        "compare", "--fail-on-regress", str(args.frac), best_prior, latest,
+    ])
+    if rc == 3:
+        msg = (
+            f"bench regression: {latest} fell more than "
+            f"{100 * args.frac:.0f}% below {best_prior}"
+        )
+        if args.strict:
+            print(f"FAIL: {msg}", file=sys.stderr)
+            return 1
+        print(f"WARN (non-gating): {msg}", file=sys.stderr)
+        return 0
+    if rc != 0:
+        print(f"FAIL: obs compare exited {rc}", file=sys.stderr)
+        return rc
+    print(f"OK: {latest} within {100 * args.frac:.0f}% of {best_prior}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

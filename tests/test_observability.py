@@ -359,3 +359,413 @@ def test_check_metrics_schema_script():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "OK" in proc.stdout
+
+
+# -- ISSUE 4: flight recorder / watchdog / doctor ---------------------------
+
+
+def test_histogram_alltime_max_survives_ring_overflow():
+    """Satellite regression: `max` is an exact all-time aggregate (like
+    count/sum/mean), `window_max` covers the retained ring.  The old
+    code reported max(ring) as `max`, so a spike older than `capacity`
+    observations silently vanished."""
+    from xflow_tpu.obs.registry import Histogram
+
+    h = Histogram(capacity=8)
+    h.observe(100.0)  # the spike, soon evicted from the ring
+    for v in range(20):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 21
+    assert s["max"] == 100.0  # all-time, despite eviction
+    assert s["window_max"] == 19.0  # newest capacity=8 values: 12..19
+    assert abs(s["mean"] - (100.0 + sum(range(20))) / 21) < 1e-9
+    empty = Histogram(capacity=4).summary()
+    assert empty["max"] == 0.0 and empty["window_max"] == 0.0
+
+
+def test_run_start_carries_hostname_and_pid(tmp_path):
+    """Satellite: every run_start row is stamped with hostname/pid by
+    MetricsLogger itself, so every emitter (trainer, serve bench,
+    smokes) gets host labels for `obs merge`/`doctor` for free."""
+    import socket
+
+    from xflow_tpu.obs.schema import validate_rows
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    out = tmp_path / "m.jsonl"
+    with MetricsLogger(str(out), run_header={
+        "run_id": "x", "config_digest": "y", "rank": 0, "num_hosts": 1,
+    }):
+        pass
+    row = json.loads(out.read_text().splitlines()[0])
+    assert row["hostname"] == socket.gethostname()
+    assert row["pid"] == os.getpid()
+    assert validate_rows([row]) == []
+    # the fields are OPTIONAL in the schema: pre-upgrade files (and
+    # old headers in append-mode files that span the upgrade) still
+    # validate, but a present field is still type-checked
+    legacy = {k: v for k, v in row.items() if k not in ("hostname", "pid")}
+    assert validate_rows([legacy]) == []
+    bad = dict(row, pid="not-an-int")
+    assert validate_rows([bad]) != []
+
+
+def test_flight_recorder_dump_roundtrip(tmp_path):
+    """The black box: notes ring-buffer, dump is atomic JSON carrying
+    the active phase, thread stacks, and the last batch/checkpoint."""
+    from xflow_tpu.obs.flight import FlightRecorder, load_dump
+
+    fl = FlightRecorder(capacity=4)
+    for i in range(10):
+        fl.note_phase("input_stall", step=i)
+    fl.note_phase("dispatch", step=10)
+    fl.note_batch({"rows": 64, "cold_nnz": 24, "hot_nnz": 0, "shard": 1})
+    fl.note_checkpoint(7)
+    fl.note_loader("block")
+    path = str(tmp_path / "flight.json")
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as e:
+        assert fl.dump(path, "exception", exc=e) == path
+    doc = load_dump(path)
+    assert doc["reason"] == "exception"
+    assert doc["active_phase"] == "dispatch"
+    assert doc["exception"]["type"] == "RuntimeError"
+    rec = doc["record"]
+    assert len(rec["events"]) == 4  # ring kept only the newest capacity
+    assert rec["last_checkpoint_step"] == 7
+    assert rec["last_batch"]["rows"] == 64
+    assert {"train", "loader"} <= set(rec["channels"])
+    assert any(t["stack"] for t in doc["threads"])
+    # no leftover tmp file from the atomic write
+    assert [p.name for p in tmp_path.iterdir()] == ["flight.json"]
+
+
+def test_watchdog_classifies_silence_per_phase(tmp_path):
+    """Unit classification: silence while in input_stall is input
+    starvation (input threshold); while in dispatch/device_block it is
+    a device hang (device threshold); 'idle' silence never trips."""
+    from xflow_tpu.obs.flight import FlightRecorder
+    from xflow_tpu.obs.watchdog import Watchdog
+
+    fl = FlightRecorder()
+    wd = Watchdog(fl, input_s=0.5, device_s=2.0, serve_s=1.0)
+    fl.note_phase("input_stall", 1)
+    now = time.perf_counter()
+    assert wd.check(now + 0.1) == []  # within threshold
+    rows = wd.check(now + 0.6)
+    assert [r["cause"] for r in rows] == ["input_stall"]
+    assert rows[0]["channel"] == "train"
+    assert rows[0]["threshold_seconds"] == 0.5
+    # recovery on the next beat
+    fl.note_phase("dispatch", 2)
+    now = time.perf_counter()
+    rows = wd.check(now)
+    assert [r["cause"] for r in rows] == ["recovered:input_stall"]
+    # dispatch silence: device threshold, not the (tighter) input one
+    assert wd.check(now + 1.0) == []
+    rows = wd.check(now + 2.5)
+    assert [r["cause"] for r in rows] == ["device_hang"]
+    fl.note_phase("idle", 3)
+    wd.check()  # recovery row for the device incident
+    assert wd.check(time.perf_counter() + 999) == []  # idle never trips
+
+
+def test_watchdog_serve_queue_stall_gated_on_pending(tmp_path):
+    """Serve-channel silence only trips while work is pending; an idle
+    batcher is healthy no matter how long it sits."""
+    from xflow_tpu.obs.flight import FlightRecorder
+    from xflow_tpu.obs.watchdog import Watchdog
+
+    fl = FlightRecorder()
+    wd = Watchdog(fl, input_s=1.0, device_s=1.0, serve_s=0.5)
+    pending = [False]
+    wd.set_pending("serve", lambda: pending[0])
+    fl.note_serve("batch")
+    now = time.perf_counter()
+    assert wd.check(now + 10.0) == []  # silent but idle: healthy
+    pending[0] = True
+    rows = wd.check(now + 10.0)
+    assert [r["cause"] for r in rows] == ["serve_queue_stall"]
+
+
+def test_watchdog_escalates_to_flight_dump(tmp_path):
+    """Trip → health row; persistence past 2x threshold → exactly one
+    flight dump per incident, written where flight_out points."""
+    from xflow_tpu.obs.flight import FlightRecorder, load_dump
+    from xflow_tpu.obs.watchdog import Watchdog
+
+    out = str(tmp_path / "flight.json")
+    fl = FlightRecorder()
+    wd = Watchdog(fl, input_s=0.5, device_s=2.0, serve_s=1.0, flight_out=out)
+    fl.note_phase("input_stall", 5)
+    now = time.perf_counter()
+    wd.check(now + 0.6)  # trip
+    assert not os.path.exists(out)  # not yet escalated
+    wd.check(now + 1.1)  # past 2x threshold
+    assert wd.dump_count == 1
+    doc = load_dump(out)
+    assert doc["reason"] == "watchdog"
+    assert doc["active_phase"] == "input_stall"
+    wd.check(now + 5.0)  # still silent: same incident, no second dump
+    assert wd.dump_count == 1
+
+
+def test_stalled_run_trips_watchdog_and_doctor_blames_input(
+    toy_dataset, tmp_path, monkeypatch
+):
+    """ISSUE 4 acceptance: a deliberately stalled toy run (loader sleep
+    injected) trips the watchdog within its threshold, lands a `health`
+    row plus a flight dump, and `obs doctor` names input_stall as the
+    dominant cause."""
+    from xflow_tpu.obs.doctor import doctor
+    from xflow_tpu.obs.flight import load_dump
+    from xflow_tpu.obs.schema import validate_rows
+
+    delay = 0.6
+    orig = Trainer.iter_train_batches
+
+    def slow(self, *a, **kw):
+        for item in orig(self, *a, **kw):
+            time.sleep(delay)
+            yield item
+
+    monkeypatch.setattr(Trainer, "iter_train_batches", slow)
+    out = tmp_path / "m.jsonl"
+    flight = tmp_path / "flight.json"
+    with Trainer(_toy_cfg(
+        toy_dataset,
+        epochs=1,
+        metrics_out=str(out),
+        obs_flight_out=str(flight),
+        obs_watchdog=True,
+        obs_watchdog_input_s=0.2,  # delay > 2x threshold => escalation
+        obs_watchdog_device_s=30.0,
+    )) as t:
+        t.train()
+        assert t._watchdog.trip_count >= 1
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert validate_rows(rows) == []
+    health = [r for r in rows if r["kind"] == "health"]
+    trips = [r for r in health if r["cause"] == "input_stall"]
+    assert trips, health
+    # tripped within its threshold: the classified silence is of
+    # threshold order, nowhere near the full injected delay
+    assert trips[0]["silence_seconds"] < delay
+    assert trips[0]["channel"] == "train"
+    # the loader-channel context rode along (starvation forensics)
+    assert "loader" in trips[0]["channels"]
+    doc = load_dump(str(flight))
+    assert doc["reason"] == "watchdog"
+    assert doc["active_phase"] == "input_stall"
+    assert any(r["kind"] == "flight_dump" for r in rows)
+    text, rc = doctor(str(out), flight_path=str(flight))
+    assert rc == 1
+    # ranked diagnosis: the dominant (first) finding is the input stall
+    first = next(l for l in text.splitlines() if l.strip().startswith("["))
+    assert "input_stall" in first, text
+
+
+def _epoch_row(epoch, rank=None, p50=0.002, p90=None, p99=None, stall=0.1):
+    row = {
+        "t": 1.0 + epoch, "kind": "train_epoch", "epoch": epoch,
+        "examples": 640.0, "steps": 10, "train_logloss": 0.6,
+        "examples_per_sec": 1000.0, "seconds": 1.0,
+        "checkpoint_seconds": 0.0, "preempted": False,
+        "phases": {"input_stall": stall, "dispatch": 1.0 - stall},
+        "overlapped": {}, "input_stall_frac": stall,
+        "step_time_p50": p50,
+        "step_time_p90": p90 if p90 is not None else p50 * 1.1,
+        "step_time_p99": p99 if p99 is not None else p50 * 1.2,
+    }
+    if rank is not None:
+        row["rank"] = rank
+    return row
+
+
+def _run_header(rank, t0=1000.0):
+    return {
+        "t": 0.0, "kind": "run_start", "run_id": f"r{rank}",
+        "config_digest": "abc", "rank": rank, "num_hosts": 2,
+        "time_unix": t0, "hostname": f"host{rank}", "pid": 100 + rank,
+    }
+
+
+def test_obs_merge_ranks_and_aligns_time(tmp_path):
+    """`obs merge`: per-host files combine into one rank-tagged stream
+    whose rows carry absolute time (header time_unix + t) and sort by
+    it; the merged file still validates."""
+    from xflow_tpu.obs.__main__ import main
+    from xflow_tpu.obs.schema import validate_rows
+
+    a, b = tmp_path / "m-r0.jsonl", tmp_path / "m-r1.jsonl"
+    a.write_text("\n".join(json.dumps(r) for r in [
+        _run_header(0, t0=1000.0), _epoch_row(0), _epoch_row(1),
+    ]) + "\n")
+    b.write_text("\n".join(json.dumps(r) for r in [
+        _run_header(1, t0=1000.5), _epoch_row(0), _epoch_row(1),
+    ]) + "\n")
+    merged = tmp_path / "merged.jsonl"
+    assert main(["merge", str(a), str(b), "--out", str(merged)]) == 0
+    rows = [json.loads(l) for l in merged.read_text().splitlines()]
+    assert len(rows) == 6
+    assert validate_rows(rows) == []
+    assert all("rank" in r and "time_unix" in r for r in rows)
+    times = [r["time_unix"] for r in rows]
+    assert times == sorted(times)
+    # rank-1 rows interleave by wall-clock, not file order
+    assert [r["rank"] for r in rows] == [0, 1, 0, 1, 0, 1]
+
+
+def test_doctor_flags_straggler_rank(tmp_path, capsys):
+    """ISSUE 4 acceptance: a two-rank merged fixture where one rank's
+    step times are ~2x the other's makes `doctor` call out the slow
+    rank as a straggler."""
+    from xflow_tpu.obs.__main__ import main
+
+    a, b = tmp_path / "m-r0.jsonl", tmp_path / "m-r1.jsonl"
+    a.write_text("\n".join(json.dumps(r) for r in [
+        _run_header(0),
+        _epoch_row(0, p50=0.002), _epoch_row(1, p50=0.002),
+    ]) + "\n")
+    b.write_text("\n".join(json.dumps(r) for r in [
+        _run_header(1, t0=1000.2),
+        _epoch_row(0, p50=0.004), _epoch_row(1, p50=0.0042),
+    ]) + "\n")
+    merged = tmp_path / "merged.jsonl"
+    assert main(["merge", str(a), str(b), "--out", str(merged)]) == 0
+    capsys.readouterr()
+    rc = main(["doctor", str(merged)])
+    text = capsys.readouterr().out
+    assert rc == 1
+    assert "straggler" in text and "rank 1" in text, text
+    # balanced ranks stay clean
+    b.write_text("\n".join(json.dumps(r) for r in [
+        _run_header(1, t0=1000.2),
+        _epoch_row(0, p50=0.0021), _epoch_row(1, p50=0.002),
+    ]) + "\n")
+    assert main(["merge", str(a), str(b), "--out", str(merged)]) == 0
+    capsys.readouterr()
+    assert main(["doctor", str(merged)]) == 0
+
+
+def test_doctor_recompile_suspicion_and_degraded_bench(tmp_path, capsys):
+    """Bimodal step times (p99 >> p50, p90 near p50) past epoch 0 read
+    as recompile suspicion; a bench artifact with degraded: true is
+    called out."""
+    from xflow_tpu.obs.__main__ import main
+
+    m = tmp_path / "m.jsonl"
+    m.write_text("\n".join(json.dumps(r) for r in [
+        _run_header(0),
+        _epoch_row(0, p50=0.002),  # warmup epoch: exempt however it looks
+        _epoch_row(1, p50=0.002, p90=0.0022, p99=0.02),
+    ]) + "\n")
+    bench = tmp_path / "BENCH_x.json"
+    bench.write_text(json.dumps({
+        "parsed": {
+            "metric": "x_train_examples_per_sec", "value": 100.0,
+            "degraded": True, "backend": "cpu",
+            "last_good_artifact": "docs/artifacts/a.json",
+        }
+    }))
+    rc = main(["doctor", str(m), "--bench", str(bench)])
+    text = capsys.readouterr().out
+    assert rc == 1
+    assert "recompile_suspicion" in text, text
+    assert "degraded_bench" in text, text
+    # smooth step times + healthy bench: clean
+    m.write_text("\n".join(json.dumps(r) for r in [
+        _run_header(0), _epoch_row(0), _epoch_row(1),
+    ]) + "\n")
+    bench.write_text(json.dumps({"parsed": {
+        "metric": "x", "value": 100.0, "degraded": False,
+    }}))
+    capsys.readouterr()
+    assert main(["doctor", str(m), "--bench", str(bench)]) == 0
+
+
+def test_doctor_warmup_exemption_survives_merge(tmp_path, capsys):
+    """Regression: in a merged stream both hosts' run_start headers
+    sort before every epoch row, so run membership must come from the
+    merge's rank/run_id tags — EACH host's first (compile-spiky) epoch
+    stays exempt from recompile suspicion, not just one."""
+    from xflow_tpu.obs.__main__ import main
+
+    spiky = dict(p50=0.002, p90=0.0022, p99=0.05)
+    a, b = tmp_path / "m-r0.jsonl", tmp_path / "m-r1.jsonl"
+    a.write_text("\n".join(json.dumps(r) for r in [
+        _run_header(0), _epoch_row(0, **spiky), _epoch_row(1),
+    ]) + "\n")
+    b.write_text("\n".join(json.dumps(r) for r in [
+        _run_header(1, t0=1000.2), _epoch_row(0, **spiky), _epoch_row(1),
+    ]) + "\n")
+    merged = tmp_path / "merged.jsonl"
+    assert main(["merge", str(a), str(b), "--out", str(merged)]) == 0
+    capsys.readouterr()
+    rc = main(["doctor", str(merged)])
+    text = capsys.readouterr().out
+    assert "recompile_suspicion" not in text, text
+    assert rc == 0
+
+
+def test_compare_fail_on_regress(tmp_path, capsys):
+    """Satellite: `obs compare --fail-on-regress FRAC` exits 3 when B
+    fell more than FRAC below A — for bench artifacts and metrics
+    files alike."""
+    from xflow_tpu.obs.__main__ import main
+
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps({"parsed": {"metric": "m", "value": 1000.0}}))
+    b.write_text(json.dumps({"parsed": {"metric": "m", "value": 800.0}}))
+    assert main(["compare", str(a), str(b)]) == 0  # no flag: report only
+    capsys.readouterr()
+    assert main([
+        "compare", "--fail-on-regress", "0.1", str(a), str(b)
+    ]) == 3
+    err = capsys.readouterr().err
+    assert "REGRESS" in err
+    # within tolerance passes, and improvement always passes
+    assert main([
+        "compare", "--fail-on-regress", "0.25", str(a), str(b)
+    ]) == 0
+    capsys.readouterr()
+    assert main([
+        "compare", "--fail-on-regress", "0.1", str(b), str(a)
+    ]) == 0
+
+
+def test_check_doctor_smoke_script():
+    """Tier-1 wiring for scripts/check_doctor_smoke.py: the toy
+    pipeline with the watchdog armed stays trip-free and `obs doctor`
+    reports clean."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "check_doctor_smoke.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_check_bench_regress_script():
+    """Tier-1 wiring for scripts/check_bench_regress.py: warn-only by
+    default (degraded containers must not hard-fail CI), strict mode
+    gates."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "check_bench_regress.py")],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "comparing latest" in proc.stdout or "SKIP" in proc.stdout

@@ -3,9 +3,13 @@ optimizer state + data cursor) and exits cleanly; --resume continues.
 
 The reference's only recovery story is ``pkill -9`` and a full restart
 (scripts/stop.sh:1, SURVEY §5 failure-detection row); this is the
-capability gap filled.
+capability gap filled.  Crash forensics (ISSUE 4) ride the same exit
+paths: an exception or preemption mid-epoch must leave a fully-flushed
+schema-valid metrics file AND a parseable flight dump naming the phase
+that was active.
 """
 
+import json
 import os
 import signal
 import subprocess
@@ -83,3 +87,64 @@ def test_sigterm_checkpoints_and_resume_completes(big_dataset, tmp_path):
     assert out.returncode == 0, out.stderr
     assert "resumed at" in out.stderr
     assert "auc" in out.stderr  # evaluation ran after completed training
+
+
+def test_midepoch_crash_flushes_metrics_and_flight_dump(
+    big_dataset, tmp_path, monkeypatch
+):
+    """ISSUE 4 satellite: an exception raised mid-epoch still yields
+    (a) a schema-valid, fully-flushed metrics file — including the
+    flight_dump pointer row — and (b) a parseable flight dump naming
+    the phase that was active when the run died."""
+    from xflow_tpu.config import Config
+    from xflow_tpu.obs.flight import load_dump
+    from xflow_tpu.obs.schema import validate_rows
+    from xflow_tpu.trainer import Trainer
+
+    out = tmp_path / "m.jsonl"
+    flight = tmp_path / "flight.json"
+    cfg = Config(
+        train_path=big_dataset.train_prefix,
+        model="lr",
+        epochs=3,
+        batch_size=64,
+        table_size_log2=14,
+        max_nnz=16,
+        num_devices=1,
+        metrics_out=str(out),
+        obs_flight_out=str(flight),
+    )
+    orig = Trainer.iter_train_batches
+
+    def dies_midway(self, *a, **kw):
+        for i, item in enumerate(orig(self, *a, **kw)):
+            if i == 3:
+                raise RuntimeError("shard went away mid-epoch")
+            yield item
+
+    monkeypatch.setattr(Trainer, "iter_train_batches", dies_midway)
+    t = Trainer(cfg)
+    with pytest.raises(RuntimeError, match="mid-epoch"):
+        t.train()
+    # (a) the metrics file is flushed, closed, and schema-valid
+    assert t.metrics_logger.closed
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert validate_rows(rows) == []
+    dump_rows = [r for r in rows if r["kind"] == "flight_dump"]
+    assert len(dump_rows) == 1
+    assert dump_rows[0]["reason"] == "exception"
+    assert dump_rows[0]["path"] == str(flight)
+    # (b) the flight dump parses and names the active phase (the crash
+    # surfaced while the loop was pulling from the input iterator)
+    doc = load_dump(str(flight))
+    assert doc["reason"] == "exception"
+    assert doc["active_phase"] == "input_stall"
+    assert dump_rows[0]["active_phase"] == "input_stall"
+    assert doc["exception"]["type"] == "RuntimeError"
+    assert "mid-epoch" in doc["exception"]["message"]
+    assert doc["record"]["last_batch"] is not None  # batches were in flight
+    assert any(t_["stack"] for t_ in doc["threads"])
+    # a second close() must not write a second dump row
+    t.close()
+    rows2 = [json.loads(l) for l in out.read_text().splitlines()]
+    assert rows2 == rows

@@ -328,6 +328,66 @@ def test_microbatcher_coalesces_and_accounts(lr_served, tmp_path):
     assert stats["requests"] == srow["requests"]
 
 
+def test_serve_watchdog_flags_backed_up_batcher(lr_served, tmp_path):
+    """ISSUE 4: the serving tier heartbeats the flight recorder (engine
+    per device call, batcher per coalesced batch), and a watchdog wired
+    to ``batcher.pending`` classifies silence-with-backlog as
+    serve_queue_stall — while a drained batcher's silence stays
+    healthy."""
+    import time
+
+    from xflow_tpu.obs import Obs
+    from xflow_tpu.obs.flight import FlightRecorder
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.obs.watchdog import Watchdog
+    from xflow_tpu.serve.batcher import MicroBatcher
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    fl = FlightRecorder()
+    engine = PredictEngine.load(
+        lr_served["artifact"], buckets=(8,), warm=True, obs=Obs(flight=fl)
+    )
+    orig = engine.predict_prepared
+    engine.predict_prepared = lambda b: (time.sleep(0.7), orig(b))[1]
+    out = tmp_path / "serve.jsonl"
+    logger = MetricsLogger(out)
+    rng = np.random.default_rng(2)
+    rows = [
+        rng.integers(0, engine.cfg.table_size, size=6) for _ in range(3)
+    ]
+    with MicroBatcher(
+        engine, max_wait_ms=0.0, max_batch=1, flight=fl
+    ) as mb:
+        wd = Watchdog(
+            fl, input_s=60.0, device_s=60.0, serve_s=0.2,
+            metrics_logger=logger,
+        )
+        wd.set_pending("serve", mb.pending)
+        with wd:  # real monitor thread (poll = serve_s / 4)
+            futs = [mb.submit(r) for r in rows]
+            got = [f.result() for f in futs]
+            # backlog existed: batch 2/3 queued behind the slowed
+            # device call after batch 1's heartbeat — a trip fired
+            assert wd.trip_count >= 1
+            # drained now: silence with pending() False never trips
+            before = wd.trip_count
+            time.sleep(0.5)
+            assert wd.trip_count == before
+            assert not mb.pending()
+    logger.close()
+    assert len(got) == 3
+    jrows = load_jsonl(str(out))
+    assert validate_rows(jrows) == []
+    causes = {r["cause"] for r in jrows if r["kind"] == "health"}
+    assert "serve_queue_stall" in causes
+    # the flight record saw both serve-side heartbeat sources; the
+    # engine's beat names the bucket the call ran in
+    details = {e["detail"] for e in fl.snapshot()["events"]}
+    assert "batch" in details
+    assert "execute:b8" in details  # bucket choice recorded
+
+
 def test_microbatcher_hot_swap(toy_dataset, tmp_path):
     """Atomic mid-serve artifact swap: later requests score on the new
     weights, and a swap to a DIFFERENT config digest is refused."""

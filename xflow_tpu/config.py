@@ -107,6 +107,33 @@ class Config:
     # Emit a per-epoch device_mem JSONL row (jax.local_devices()
     # memory_stats) when metrics_out is set.
     obs_device_memory: bool = True
+    # Flight recorder (obs/flight.py): crash/hang forensics dump path
+    # ("" = off).  The recorder itself is an always-on bounded ring of
+    # recent state (phase transitions, batch shapes, checkpoint steps,
+    # heartbeats); on unhandled exception, preemption, or watchdog trip
+    # the whole record — plus per-thread stacks, the live metrics
+    # snapshot, and the span-trace tail — is written here atomically.
+    # Multi-host appends "-r<rank>".  Read it with `python -m
+    # xflow_tpu.obs doctor RUN.jsonl --flight DUMP`.
+    obs_flight_out: str = ""
+    # Flight-recorder event-ring capacity (newest N notes kept).
+    obs_flight_events: int = 256
+    # Stall watchdog (obs/watchdog.py): a monitor thread fed by the
+    # hot paths' heartbeats that classifies silence into input
+    # starvation / device hang / serve queue stall, emits `health`
+    # JSONL rows + instant trace events, and escalates to a flight
+    # dump when the silence persists (2x threshold).
+    obs_watchdog: bool = False
+    # Per-cause silence thresholds, seconds.  input: the main loop has
+    # been waiting on the input iterator; device: it has been inside
+    # dispatch/h2d/device_block/checkpoint; serve: the MicroBatcher
+    # has pending requests but finished no batch.
+    obs_watchdog_input_s: float = 30.0
+    obs_watchdog_device_s: float = 120.0
+    obs_watchdog_serve_s: float = 10.0
+    # Monitor poll interval (0 = auto: a quarter of the tightest
+    # threshold, so a stall is classified within its threshold).
+    obs_watchdog_poll_s: float = 0.0
 
     # -- eval / artifacts --
     # Prediction dump target.  With pred_style="single" (default) rank 0
@@ -325,6 +352,17 @@ class Config:
             raise ValueError(f"unknown wire_mode {self.wire_mode!r}")
         if self.obs_trace_capacity < 1:
             raise ValueError("obs_trace_capacity must be >= 1")
+        if self.obs_flight_events < 1:
+            raise ValueError("obs_flight_events must be >= 1")
+        if self.obs_watchdog:
+            if min(
+                self.obs_watchdog_input_s,
+                self.obs_watchdog_device_s,
+                self.obs_watchdog_serve_s,
+            ) <= 0:
+                raise ValueError("watchdog thresholds must be > 0")
+            if self.obs_watchdog_poll_s < 0:
+                raise ValueError("obs_watchdog_poll_s must be >= 0")
 
     @property
     def table_size(self) -> int:

@@ -265,7 +265,10 @@ class ShardLoader:
             hash_seed=self.hash_seed,
             remap=self.remap,
         )
+        flight = self.obs.flight
         for batch, _, next_offset in packed.iter_batches(f, start_offset):
+            if flight is not None:
+                flight.note_loader("packed_batch")
             yield batch, next_offset
 
     def _batches_from_blocks(
@@ -277,7 +280,13 @@ class ShardLoader:
         next_offset) source (text parser or binary cache)."""
         carry: ParsedBlock | None = None
         end_offset = start_offset
+        flight = self.obs.flight
         for block, raw_offset, next_offset in blocks:
+            # watchdog heartbeat (obs/flight.py): the input pipeline is
+            # alive.  A starving trainer with a BEATING loader points
+            # at transfer/backpressure, not at parsing.
+            if flight is not None:
+                flight.note_loader("block")
             end_offset = next_offset
             if carry is not None and carry.num_samples:
                 block = _concat_blocks(carry, block)

@@ -65,16 +65,25 @@ class _Phase:
 
 
 class Obs:
-    """One tracer + one registry, shared by everything in a run."""
+    """One tracer + one registry (and, when diagnosis is on, one
+    flight recorder) shared by everything in a run.
 
-    __slots__ = ("tracer", "registry")
+    ``flight`` is the heartbeat sink (obs/flight.py): hot paths that
+    hold an Obs — ShardLoader, PredictEngine — pulse it with
+    ``note_loader``/``note_serve`` so the watchdog (obs/watchdog.py)
+    can classify silence.  None when diagnosis is off: callers guard
+    with ``if obs.flight is not None`` (one attribute read per beat
+    site, nothing allocated)."""
+
+    __slots__ = ("tracer", "registry", "flight")
     enabled = True
 
-    def __init__(self, tracer=None, registry=None):
+    def __init__(self, tracer=None, registry=None, flight=None):
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.registry = (
             registry if registry is not None else MetricsRegistry()
         )
+        self.flight = flight
 
     def phase(self, name: str) -> _Phase:
         return _Phase(self, name)
@@ -103,6 +112,7 @@ class NullObs:
     enabled = False
     tracer = NULL_TRACER
     registry = NULL_REGISTRY
+    flight = None
 
     def phase(self, name: str):
         return NULL_SPAN

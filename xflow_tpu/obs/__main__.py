@@ -1,11 +1,23 @@
-"""CLI: ``python -m xflow_tpu.obs <summarize|validate|compare> ...``
+"""CLI: ``python -m xflow_tpu.obs <summarize|validate|compare|merge|doctor>``
 
-    summarize run.jsonl      phase/throughput/percentile tables per run
-    compare   a.jsonl b.jsonl  side-by-side diff of the last run in each
-    validate  run.jsonl      strict schema check (exit 1 on violations)
+    summarize run.jsonl       phase/throughput/percentile tables per run
+    compare   a b             side-by-side diff: metrics JSONL files
+                              (last run each) or bench artifacts
+                              (BENCH_r*.json); --fail-on-regress FRAC
+                              exits 3 when B's throughput fell more
+                              than FRAC below A's
+    validate  run.jsonl       strict schema check (exit 1 on violations)
+    merge     a.jsonl b.jsonl combine per-host metrics files into one
+                              rank-tagged, time-aligned stream
+                              (--out FILE, default stdout)
+    doctor    run.jsonl       ranked diagnosis of a sick (or healthy)
+                              run: stall causes, stragglers, recompile
+                              suspicion (--flight DUMP, --bench JSON);
+                              exit 0 only when clean
 
 Pure host-side file processing — never imports jax, so it runs
 anywhere (including hosts with no accelerator runtime).
+Docs: docs/OBSERVABILITY.md ("Diagnosing a sick run").
 """
 
 from __future__ import annotations
@@ -14,7 +26,7 @@ import argparse
 import sys
 
 from xflow_tpu.obs.schema import load_jsonl, validate_rows
-from xflow_tpu.obs.summary import compare, summarize
+from xflow_tpu.obs.summary import check_regress, compare, summarize
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -27,9 +39,32 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("path")
     pv = sub.add_parser("validate", help="strict schema check")
     pv.add_argument("path")
-    pc = sub.add_parser("compare", help="diff the last run of two files")
+    pc = sub.add_parser(
+        "compare", help="diff two metrics files or bench artifacts"
+    )
     pc.add_argument("path_a")
     pc.add_argument("path_b")
+    pc.add_argument(
+        "--fail-on-regress",
+        type=float,
+        default=None,
+        metavar="FRAC",
+        help="exit 3 when B's throughput is more than FRAC (e.g. 0.05) "
+        "below A's — the scripts/check_bench_regress.py gate",
+    )
+    pm = sub.add_parser(
+        "merge", help="combine per-host metrics files into one stream"
+    )
+    pm.add_argument("paths", nargs="+")
+    pm.add_argument("--out", default="", help="output file (default stdout)")
+    pd = sub.add_parser("doctor", help="ranked diagnosis of a run")
+    pd.add_argument("path", help="metrics JSONL (single-host or merged)")
+    pd.add_argument(
+        "--flight", default="", help="flight dump (Config.obs_flight_out)"
+    )
+    pd.add_argument(
+        "--bench", default="", help="bench artifact (BENCH_r*.json)"
+    )
     args = p.parse_args(argv)
 
     if args.cmd == "summarize":
@@ -45,10 +80,50 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "compare":
         try:
             print(compare(args.path_a, args.path_b))
+            if args.fail_on_regress is not None:
+                verdict = check_regress(
+                    args.path_a, args.path_b, args.fail_on_regress
+                )
+                if verdict is not None:
+                    print(verdict, file=sys.stderr)
+                    return 3
         except ValueError as e:  # empty/headerless file: diagnose, not crash
             print(f"error: {e}", file=sys.stderr)
             return 1
         return 0
+    if args.cmd == "merge":
+        from xflow_tpu.obs.doctor import merge_rows, write_jsonl
+
+        try:
+            rows = merge_rows(args.paths)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if args.out:
+            with open(args.out, "w") as f:
+                write_jsonl(rows, f)
+            print(
+                f"{args.out}: {len(rows)} rows merged from "
+                f"{len(args.paths)} file(s)",
+                file=sys.stderr,
+            )
+        else:
+            write_jsonl(rows, sys.stdout)
+        return 0
+    if args.cmd == "doctor":
+        from xflow_tpu.obs.doctor import doctor
+
+        try:
+            text, rc = doctor(
+                args.path,
+                flight_path=args.flight or None,
+                bench_path=args.bench or None,
+            )
+        except (ValueError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(text)
+        return rc
     return 2  # unreachable (subparsers required)
 
 

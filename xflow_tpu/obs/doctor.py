@@ -1,0 +1,402 @@
+"""``obs merge`` + ``obs doctor`` — first-responder forensics.
+
+``merge`` combines per-host metrics JSONL files into one rank-tagged,
+time-aligned stream: every row gains ``rank`` (from its file's
+``run_start`` header) and ``time_unix`` (the header's wall-clock epoch
+plus the row's relative ``t``), and rows sort globally by that clock.
+Extra fields are schema-compatible (validators check required fields
+only), so a merged file still passes ``obs validate``.
+
+``doctor`` reads one run stream (merged or single-host), optionally a
+flight dump (obs/flight.py) and a bench artifact, and prints a RANKED
+diagnosis instead of raw JSONL:
+
+* watchdog ``health`` rows → dominant stall cause with trip counts and
+  worst silence;
+* flight dump → why the run died and what every thread was doing;
+* phase accounting → the dominant wall-clock phase, with an
+  input-bound callout when stalls dominate;
+* per-rank step-time skew → straggler host callout (merged streams);
+* step-time shape → bimodality (p99 ≫ p50 while p90 stays near p50)
+  as recompile suspicion;
+* bench artifact → degraded-bench detection (``degraded: true``).
+
+Severity ranks ``crit`` > ``warn`` > ``info``; the CLI exits 0 only
+when nothing at ``warn`` or above surfaced — "run one command, get a
+verdict" (scripts/check_doctor_smoke.py gates the healthy-run path).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from xflow_tpu.obs.schema import load_jsonl
+from xflow_tpu.obs.summary import split_runs
+
+# straggler: slowest rank's mean step-time p50 vs the fleet median
+STRAGGLER_RATIO = 1.3
+# input-bound: TIME-WEIGHTED input_stall fraction of total wall-clock
+# (0.44 steady-state stall is normal for a CPU toy run; 0.5+ of the
+# whole run means the device mostly waited)
+INPUT_BOUND_FRAC = 0.5
+# bimodality: p99 >= BIMODAL_P99 * p50 while p90 <= BIMODAL_P90 * p50
+# (a fat smooth tail raises p90 too; a recompile spike does not).
+# Each run's FIRST epoch row is exempt — it legitimately contains the
+# process's one-time XLA compile, which IS a giant outlier step.
+BIMODAL_P99 = 3.0
+BIMODAL_P90 = 1.5
+
+_SEV_ORDER = {"crit": 0, "warn": 1, "info": 2}
+
+
+@dataclass
+class Diagnosis:
+    severity: str  # "crit" | "warn" | "info"
+    code: str  # short machine-greppable tag
+    message: str
+
+
+# -- merge ------------------------------------------------------------------
+
+
+def merge_rows(paths: list[str]) -> list[dict]:
+    """Rank-tagged, time-aligned union of per-host metrics files."""
+    merged: list[dict] = []
+    for path in paths:
+        for run in split_runs(load_jsonl(path)):
+            header = run.header or {}
+            rank = int(header.get("rank", 0))
+            t0 = float(header.get("time_unix", 0.0))
+            run_id = str(header.get("run_id", ""))
+            rows = ([header] if run.header else []) + run.rows
+            for row in rows:
+                out = dict(row)
+                out.setdefault("rank", rank)
+                # run_id tag: time-sorting interleaves the per-host
+                # streams, so split_runs no longer recovers run
+                # membership — the explicit tag does
+                out.setdefault("run_id", run_id)
+                out.setdefault(
+                    "time_unix", round(t0 + float(row.get("t", 0.0)), 3)
+                )
+                merged.append(out)
+    merged.sort(key=lambda r: r.get("time_unix", 0.0))
+    return merged
+
+
+def write_jsonl(rows: list[dict], f) -> None:
+    for row in rows:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+
+
+# -- doctor checks ----------------------------------------------------------
+
+
+def _rank_of(row: dict, header_rank: int) -> int:
+    return int(row.get("rank", header_rank))
+
+
+def _epoch_rows(rows: list[dict]) -> list[tuple[int, dict]]:
+    """(rank, train_epoch row) pairs across every run in the stream."""
+    out = []
+    for run in split_runs(rows):
+        hr = int((run.header or {}).get("rank", 0))
+        for e in run.epochs:
+            out.append((_rank_of(e, hr), e))
+    return out
+
+
+def _warm_epoch_rows(rows: list[dict]) -> list[dict]:
+    """train_epoch rows EXCLUDING each (rank, run)'s first one: every
+    fresh process (initial or resumed — a resume is a new run_start)
+    pays the one-time XLA compile in its first epoch, which is a
+    legitimate giant step-time outlier, not a recompile bug.
+
+    Grouping is by the rows' rank/run_id tags when present (merged
+    streams interleave hosts by wall-clock, so split_runs alone puts
+    every row in the LAST header's run and would exempt only one
+    host's warmup); unmerged files fall back to split_runs order."""
+    groups: dict = {}
+    for i, run in enumerate(split_runs(rows)):
+        header = run.header or {}
+        hr = header.get("rank", 0)
+        hid = header.get("run_id", i)
+        for e in run.epochs:
+            key = (e.get("rank", hr), e.get("run_id", hid))
+            groups.setdefault(key, []).append(e)
+    out = []
+    for epochs in groups.values():
+        # stream order is time order (merge sorts; single files append)
+        out.extend(epochs[1:])
+    return out
+
+
+def _check_health(rows: list[dict]) -> list[Diagnosis]:
+    trips: dict[str, list[dict]] = {}
+    recovered: dict[str, float] = {}
+    for r in rows:
+        if r.get("kind") != "health":
+            continue
+        cause = r.get("cause", "?")
+        if cause.startswith("recovered:"):
+            orig = cause.split(":", 1)[1]
+            recovered[orig] = max(
+                recovered.get(orig, 0.0), float(r.get("silence_seconds", 0))
+            )
+        else:
+            trips.setdefault(cause, []).append(r)
+    out = []
+    for cause, events in sorted(
+        trips.items(), key=lambda kv: -len(kv[1])
+    ):
+        worst = max(
+            [float(e.get("silence_seconds", 0)) for e in events]
+            + [recovered.get(cause, 0.0)]
+        )
+        ranks = sorted({_rank_of(e, 0) for e in events})
+        out.append(Diagnosis(
+            "crit",
+            cause,
+            f"watchdog tripped {len(events)}x: {cause} on channel "
+            f"{events[-1].get('channel', '?')!r} (worst silence "
+            f"{worst:.1f}s over threshold "
+            f"{events[-1].get('threshold_seconds', 0)}s, rank(s) "
+            f"{ranks})",
+        ))
+    dumps = [r for r in rows if r.get("kind") == "flight_dump"]
+    for d in dumps:
+        out.append(Diagnosis(
+            "info",
+            "flight_dump_row",
+            f"flight dump recorded at {d.get('path', '?')} (reason "
+            f"{d.get('reason', '?')!r}, active phase "
+            f"{d.get('active_phase', '?')!r}) — pass it via --flight "
+            "for thread stacks",
+        ))
+    return out
+
+
+def _check_phases(rows: list[dict]) -> list[Diagnosis]:
+    epochs = [e for _, e in _epoch_rows(rows)]
+    if not epochs:
+        return []
+    totals: dict[str, float] = {}
+    wall = 0.0
+    for e in epochs:
+        wall += float(e.get("seconds", 0.0))
+        for k, v in (e.get("phases") or {}).items():
+            totals[k] = totals.get(k, 0.0) + float(v)
+    if not totals or wall <= 0:
+        return []
+    name, secs = max(totals.items(), key=lambda kv: kv[1])
+    out = [Diagnosis(
+        "info",
+        "dominant_phase",
+        f"dominant phase: {name} ({secs:.2f}s, {100 * secs / wall:.0f}% "
+        f"of {wall:.2f}s wall over {len(epochs)} epoch row(s))",
+    )]
+    stall = totals.get("input_stall", 0.0) / wall  # time-weighted
+    if stall >= INPUT_BOUND_FRAC:
+        out.append(Diagnosis(
+            "warn",
+            "input_bound",
+            f"input-bound: input_stall is {100 * stall:.0f}% of total "
+            "wall-clock — the device is waiting on data (check loader "
+            "throughput in the shard rows, parse workers, prefetch "
+            "depth)",
+        ))
+    return out
+
+
+def _check_stragglers(rows: list[dict]) -> list[Diagnosis]:
+    per_rank: dict[int, list[float]] = {}
+    for rank, e in _epoch_rows(rows):
+        p50 = float(e.get("step_time_p50", 0.0))
+        if p50 > 0:
+            per_rank.setdefault(rank, []).append(p50)
+    if len(per_rank) < 2:
+        return []
+    means = {
+        rank: sum(v) / len(v) for rank, v in per_rank.items()
+    }
+    # lower-middle median: with an even rank count (2 hosts being the
+    # common case) the candidate straggler must compare against the
+    # FASTER half, not against itself
+    ordered = sorted(means.values())
+    median = ordered[(len(ordered) - 1) // 2]
+    if median <= 0:
+        return []
+    worst_rank, worst = max(means.items(), key=lambda kv: kv[1])
+    if worst < median * STRAGGLER_RATIO:
+        return [Diagnosis(
+            "info",
+            "rank_skew",
+            f"step-time skew across {len(means)} ranks is "
+            f"{worst / median:.2f}x (max/median) — within the "
+            f"{STRAGGLER_RATIO}x straggler threshold",
+        )]
+    return [Diagnosis(
+        "warn",
+        "straggler",
+        f"straggler: rank {worst_rank} mean step-time p50 "
+        f"{1e3 * worst:.2f}ms is {worst / median:.2f}x the fleet "
+        f"median ({1e3 * median:.2f}ms) across {len(means)} ranks — "
+        "every synced step waits for it (slow host, shard skew, or "
+        "thermal throttling)",
+    )]
+
+
+def _check_bimodality(rows: list[dict]) -> list[Diagnosis]:
+    suspect = []
+    for e in _warm_epoch_rows(rows):
+        p50 = float(e.get("step_time_p50", 0.0))
+        p90 = float(e.get("step_time_p90", 0.0))
+        p99 = float(e.get("step_time_p99", 0.0))
+        if (
+            p50 > 0
+            and p99 >= BIMODAL_P99 * p50
+            and p90 <= BIMODAL_P90 * p50
+        ):
+            suspect.append(e)
+    if not suspect:
+        return []
+    e = suspect[-1]
+    return [Diagnosis(
+        "warn",
+        "recompile_suspicion",
+        f"step-time bimodality in {len(suspect)} epoch row(s): p99 "
+        f"{1e3 * float(e['step_time_p99']):.1f}ms is "
+        f"{float(e['step_time_p99']) / float(e['step_time_p50']):.1f}x "
+        f"p50 while p90 stays near p50 — a few steps are wildly slower "
+        "than the rest, the signature of silent recompiles (new batch "
+        "shape?) or periodic interference; check XF001 and the span "
+        "trace around the slow steps",
+    )]
+
+
+def _check_flight(flight: dict) -> list[Diagnosis]:
+    reason = flight.get("reason", "?")
+    phase = flight.get("active_phase", "")
+    threads = flight.get("threads", [])
+    record = flight.get("record", {})
+    sev = "crit" if reason in ("exception", "watchdog") else "warn"
+    msg = (
+        f"flight dump: run ended by {reason!r} while in phase "
+        f"{phase or '?'} at step {record.get('last_step', '?')} "
+        f"(last checkpoint step: {record.get('last_checkpoint_step')}, "
+        f"{len(threads)} thread stacks captured)"
+    )
+    exc = flight.get("exception")
+    if exc:
+        msg += f"; exception {exc.get('type')}: {exc.get('message')}"
+    out = [Diagnosis(sev, f"flight_{reason}", msg)]
+    chans = record.get("channels", {})
+    if chans:
+        ages = ", ".join(
+            f"{ch} {info.get('detail', '?')!r} {info.get('age_seconds', 0):.1f}s ago"
+            for ch, info in sorted(chans.items())
+        )
+        out.append(Diagnosis(
+            "info", "flight_channels", f"last heartbeats at dump: {ages}"
+        ))
+    return out
+
+
+def _check_bench(bench: dict) -> list[Diagnosis]:
+    parsed = bench.get("parsed") if isinstance(bench, dict) else None
+    row = parsed if isinstance(parsed, dict) else bench
+    if not isinstance(row, dict) or "value" not in row:
+        return [Diagnosis(
+            "info", "bench_unreadable",
+            "bench artifact has no parsed result row — run bench.py "
+            "to completion first",
+        )]
+    if row.get("degraded"):
+        return [Diagnosis(
+            "warn",
+            "degraded_bench",
+            f"degraded bench: {row.get('metric', '?')} = "
+            f"{row.get('value')} measured on backend "
+            f"{row.get('backend', '?')!r} (degraded environment — not "
+            "comparable to the committed trajectory; last good: "
+            f"{row.get('last_good_artifact', '?')})",
+        )]
+    return [Diagnosis(
+        "info", "bench_ok",
+        f"bench: {row.get('metric', '?')} = {row.get('value')} on "
+        f"{row.get('backend', '?')} (not degraded)",
+    )]
+
+
+def diagnose(
+    rows: list[dict],
+    flight: dict | None = None,
+    bench: dict | None = None,
+) -> list[Diagnosis]:
+    """Every check, ranked most-severe-first (stable within rank)."""
+    findings: list[Diagnosis] = []
+    findings.extend(_check_health(rows))
+    if flight is not None:
+        findings.extend(_check_flight(flight))
+    findings.extend(_check_phases(rows))
+    findings.extend(_check_stragglers(rows))
+    findings.extend(_check_bimodality(rows))
+    if bench is not None:
+        findings.extend(_check_bench(bench))
+    preempted = sum(
+        1 for _, e in _epoch_rows(rows) if e.get("preempted")
+    )
+    if preempted:
+        findings.append(Diagnosis(
+            "info", "preempted",
+            f"{preempted} epoch row(s) ended by graceful preemption "
+            "(resume with --resume)",
+        ))
+    findings.sort(key=lambda d: _SEV_ORDER.get(d.severity, 3))
+    return findings
+
+
+def format_diagnosis(
+    path: str, rows: list[dict], findings: list[Diagnosis]
+) -> str:
+    ranks = sorted({
+        int(r.get("rank", h.get("rank", 0)))
+        for run in split_runs(rows)
+        for h in [run.header or {}]
+        for r in ([run.header] if run.header else []) + run.rows
+    })
+    out = [
+        f"obs doctor — {path}: {len(rows)} rows, "
+        f"{len(split_runs(rows))} run(s), rank(s) {ranks}"
+    ]
+    for d in findings:
+        out.append(f"  [{d.severity.upper():4s}] {d.code}: {d.message}")
+    problems = sum(1 for d in findings if d.severity in ("crit", "warn"))
+    out.append(
+        "diagnosis: clean (no crit/warn findings)"
+        if not problems
+        else f"diagnosis: {problems} problem(s) — ranked above"
+    )
+    return "\n".join(out)
+
+
+def doctor(
+    path: str,
+    flight_path: str | None = None,
+    bench_path: str | None = None,
+) -> tuple[str, int]:
+    """(report text, exit code): 0 clean, 1 when anything at warn or
+    above surfaced."""
+    from xflow_tpu.obs.flight import load_dump
+
+    rows = load_jsonl(path)
+    flight = load_dump(flight_path) if flight_path else None
+    bench = None
+    if bench_path:
+        with open(bench_path) as f:
+            bench = json.load(f)
+    findings = diagnose(rows, flight=flight, bench=bench)
+    text = format_diagnosis(path, rows, findings)
+    bad = any(d.severity in ("crit", "warn") for d in findings)
+    return text, 1 if bad else 0

@@ -1,0 +1,255 @@
+"""Flight recorder — always-on, bounded in-memory record of recent
+run state, dumped atomically on crash/preemption/watchdog trip.
+
+A hang or crash mid-epoch used to leave only whatever JSONL happened to
+flush; the forensic questions ("what was the trainer DOING?  which
+shard?  when did it last checkpoint?  what are the threads stuck on?")
+had no answer.  The recorder keeps exactly that state, cheaply:
+
+* a ring of the newest ``capacity`` noted events (phase transitions,
+  batch shapes, checkpoint saves, serve batches) — O(1) per note, no
+  growth on arbitrarily long runs;
+* per-channel last-heartbeat state (``train``/``loader``/``serve``)
+  that doubles as the watchdog's liveness feed (obs/watchdog.py reads
+  it; the notes ARE the heartbeats);
+* at dump time only: per-thread stack dumps (``sys._current_frames``),
+  the live metrics-registry snapshot, and the tail of the span
+  tracer's ring.
+
+``dump()`` writes the whole record as one JSON document via tmp-file +
+``os.replace`` (atomic on POSIX: a reader never sees a torn dump) and
+logs a ``flight_dump`` JSONL row pointing at it, so ``obs doctor``
+finds the dump from the metrics stream alone.
+
+Thread-safety (XF003 discipline): every mutation of shared state takes
+``self._lock``; notes are a clock read + two dict/deque stores —
+nothing on the hot path blocks or syncs the device (XF002).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any
+
+FORMAT_VERSION = 1
+
+# tracer-ring tail kept in a dump: enough to see the last few steps'
+# span structure without re-serializing the whole 65536-event ring
+_DUMP_SPAN_TAIL = 256
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        capacity: int = 256,
+        metrics_logger=None,
+        registry=None,
+        tracer=None,
+        rank: int = 0,
+    ):
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self._events: deque = deque(maxlen=capacity)
+        # channel -> (perf_counter seconds, detail str); the watchdog's
+        # liveness feed (last_beat/beat_age read it)
+        self._channels: dict[str, tuple[float, str]] = {}
+        self._last_batch: dict[str, Any] | None = None
+        self._last_checkpoint_step: int | None = None
+        self._last_step: int = 0
+        self.metrics_logger = metrics_logger
+        self.registry = registry
+        self.tracer = tracer
+        self.rank = rank
+        self._t0 = time.time()
+        self._t0_perf = time.perf_counter()
+
+    # -- hot-path notes (cheap: one clock read + ONE locked store) ---------
+
+    def _note(self, kind: str, detail: str, channel: str | None = None) -> None:
+        """Append to the event ring and (when ``channel``) update that
+        channel's heartbeat — one lock acquisition per beat, so a
+        concurrent dump() never sees an event without its channel
+        update."""
+        now = time.perf_counter()
+        with self._lock:
+            self._events.append((round(now - self._t0_perf, 6), kind, detail))
+            if channel is not None:
+                self._channels[channel] = (now, detail)
+
+    def note_phase(self, phase: str, step: int = 0) -> None:
+        """Trainer heartbeat: the main loop just ENTERED ``phase`` at
+        global step ``step``.  Silence after an ``input_stall`` note
+        means the loop is starved; after ``dispatch``/``device_block``
+        it means the device (or its queue) is wedged."""
+        now = time.perf_counter()
+        with self._lock:
+            self._events.append(
+                (round(now - self._t0_perf, 6), "phase", phase)
+            )
+            self._channels["train"] = (now, phase)
+            self._last_step = step
+
+    def note_loader(self, detail: str = "block") -> None:
+        """Loader heartbeat: a block parsed / a batch assembled.  A
+        starving trainer WITH a beating loader points at transfer or
+        consumer backpressure, not the input pipeline itself."""
+        self._note("loader", detail, channel="loader")
+
+    def note_serve(self, detail: str = "batch") -> None:
+        """Serving heartbeat: the MicroBatcher finished (or the engine
+        executed) one batch."""
+        self._note("serve", detail, channel="serve")
+
+    def note_batch(self, shape: dict[str, Any]) -> None:
+        """Record the most recent batch geometry (rows/nnz/bucket) —
+        the 'what data was in flight' forensic."""
+        with self._lock:
+            self._last_batch = dict(shape)
+            self._events.append((
+                round(time.perf_counter() - self._t0_perf, 6),
+                "batch",
+                json.dumps(shape, sort_keys=True),
+            ))
+
+    def note_checkpoint(self, step: int) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            self._last_checkpoint_step = int(step)
+            self._events.append(
+                (round(now - self._t0_perf, 6), "checkpoint", f"step={step}")
+            )
+
+    # -- watchdog feed ------------------------------------------------------
+
+    def beat_age(self, channel: str, now: float | None = None) -> float | None:
+        """Seconds since ``channel`` last beat (None = never beat)."""
+        state = self.channel_state(channel, now)
+        return None if state is None else state[0]
+
+    def channel_state(
+        self, channel: str, now: float | None = None
+    ) -> tuple[float, str] | None:
+        """(beat age seconds, last detail) read ATOMICALLY — the
+        watchdog classifies on this pair, and reading them under
+        separate lock acquisitions would let a phase transition land
+        in between (a stale large age paired with the new phase's
+        tighter threshold = spurious trip)."""
+        if now is None:
+            now = time.perf_counter()
+        with self._lock:
+            last = self._channels.get(channel)
+        return None if last is None else (now - last[0], last[1])
+
+    def last_detail(self, channel: str) -> str | None:
+        with self._lock:
+            last = self._channels.get(channel)
+        return None if last is None else last[1]
+
+    # -- dump ---------------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """The in-memory record as plain JSON-ready dicts (no stacks,
+        no registry — those are dump-time extras)."""
+        now = time.perf_counter()
+        with self._lock:
+            channels = {
+                ch: {"age_seconds": round(now - t, 6), "detail": d}
+                for ch, (t, d) in self._channels.items()
+            }
+            events = [
+                {"t": t, "kind": k, "detail": d} for t, k, d in self._events
+            ]
+            last_batch = self._last_batch
+            last_ckpt = self._last_checkpoint_step
+            last_step = self._last_step
+        return {
+            "channels": channels,
+            "events": events,
+            "last_batch": last_batch,
+            "last_checkpoint_step": last_ckpt,
+            "last_step": last_step,
+        }
+
+    def _thread_stacks(self) -> list[dict[str, Any]]:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        stacks = []
+        for ident, frame in sys._current_frames().items():
+            stacks.append({
+                "thread_id": ident,
+                "name": names.get(ident, "?"),
+                "stack": traceback.format_stack(frame),
+            })
+        return stacks
+
+    def dump(
+        self,
+        path: str,
+        reason: str,
+        exc: BaseException | None = None,
+    ) -> str | None:
+        """Write the full record to ``path`` atomically; returns the
+        path (None when writing failed — a dying process must not die
+        harder because its black box had a disk error)."""
+        active = self.last_detail("train") or ""
+        doc: dict[str, Any] = {
+            "format_version": FORMAT_VERSION,
+            "reason": reason,
+            "time_unix": round(time.time(), 3),
+            "rank": self.rank,
+            "active_phase": active,
+            "record": self.snapshot(),
+            "threads": self._thread_stacks(),
+        }
+        if exc is not None:
+            doc["exception"] = {
+                "type": type(exc).__name__,
+                "message": str(exc),
+                "traceback": traceback.format_exception(
+                    type(exc), exc, exc.__traceback__
+                ),
+            }
+        if self.registry is not None:
+            snap = self.registry.snapshot()
+            doc["metrics"] = {
+                "counters": snap.counters,
+                "gauges": snap.gauges,
+                "hists": snap.hists,
+            }
+        if self.tracer is not None and self.tracer.enabled:
+            doc["spans"] = self.tracer.events()[-_DUMP_SPAN_TAIL:]
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        if self.metrics_logger is not None:
+            self.metrics_logger.log("flight_dump", {
+                "path": path,
+                "reason": reason,
+                "active_phase": active,
+            })
+        return path
+
+
+def load_dump(path: str) -> dict[str, Any]:
+    """Parse a flight dump; raises ValueError on a malformed file."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"{path}: not a valid flight dump: {e}")
+    if not isinstance(doc, dict) or "reason" not in doc:
+        raise ValueError(f"{path}: not a flight dump (no 'reason' field)")
+    return doc

@@ -19,18 +19,25 @@ class Histogram:
     """Sliding-window value recorder with percentile summaries.
 
     Keeps the newest ``capacity`` observations in a ring (plus exact
-    running count/sum), so percentiles reflect the recent window and
-    memory stays bounded on arbitrarily long runs.  Step-time p50/p90/
-    p99 are the intended use; 4096 samples cover several epochs of toy
-    runs and a representative window of production ones.
+    running count/sum/max), so percentiles reflect the recent window
+    and memory stays bounded on arbitrarily long runs.  Step-time p50/
+    p90/p99 are the intended use; 4096 samples cover several epochs of
+    toy runs and a representative window of production ones.
+
+    ``summary()`` windows: ``count``/``sum``/``mean``/``max`` are exact
+    ALL-TIME aggregates; the percentiles and ``window_max`` cover only
+    the retained ring.  (``max`` used to silently switch to the window
+    once the ring wrapped — a one-off spike older than ``capacity``
+    observations vanished from the summary.)
     """
 
-    __slots__ = ("capacity", "count", "sum", "_vals")
+    __slots__ = ("capacity", "count", "sum", "max", "_vals")
 
     def __init__(self, capacity: int = 4096):
         self.capacity = capacity
         self.count = 0
         self.sum = 0.0
+        self.max = float("-inf")
         self._vals: list[float] = []
 
     def observe(self, v: float) -> None:
@@ -40,6 +47,8 @@ class Histogram:
             self._vals[self.count % self.capacity] = v
         self.count += 1
         self.sum += v
+        if v > self.max:
+            self.max = v
 
     def percentile(self, p: float) -> float:
         """Nearest-rank percentile over the retained window (p in
@@ -57,7 +66,8 @@ class Histogram:
             "p50": self.percentile(50),
             "p90": self.percentile(90),
             "p99": self.percentile(99),
-            "max": max(self._vals) if self._vals else 0.0,
+            "max": self.max if self.count else 0.0,
+            "window_max": max(self._vals) if self._vals else 0.0,
         }
 
 

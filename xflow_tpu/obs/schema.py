@@ -23,7 +23,10 @@ from typing import Any, Iterable
 # Backend-dependent values (e.g. device memory stats) are OMITTED from
 # their row rather than emitted as null, so no nullable types exist.
 SCHEMA: dict[str, dict[str, Any]] = {
-    # one per MetricsLogger open (run delimiter — summarize splits here)
+    # one per MetricsLogger open (run delimiter — summarize splits here;
+    # hostname/pid — OPTIONAL below — let `obs merge`/`obs doctor`
+    # label hosts in multi-host runs; stamped centrally by
+    # MetricsLogger, so every current emitter carries them)
     "run_start": {
         "t": (int, float),
         "kind": str,
@@ -137,6 +140,45 @@ SCHEMA: dict[str, dict[str, Any]] = {
         "device_p99": (int, float),
         "compiles": int,
     },
+    # -- diagnosis (obs/watchdog.py, obs/flight.py; docs/OBSERVABILITY.md
+    # "Diagnosing a sick run") ---------------------------------------------
+    # one per watchdog incident transition: a trip (cause names the
+    # classified stall) or a recovery (cause "recovered:<original>",
+    # silence_seconds = how long the stall lasted)
+    "health": {
+        "t": (int, float),
+        "kind": str,
+        "cause": str,
+        "channel": str,
+        "silence_seconds": (int, float),
+        "threshold_seconds": (int, float),
+        "detail": str,
+        # every channel's last-heartbeat age at emission — the
+        # cross-channel context that separates "loader dead" from
+        # "loader fine, transfer wedged"
+        "channels": dict,
+    },
+    # one per flight-recorder dump: a pointer row so `obs doctor` finds
+    # the dump file from the metrics stream alone
+    "flight_dump": {
+        "t": (int, float),
+        "kind": str,
+        "path": str,
+        "reason": str,
+        "active_phase": str,
+    },
+}
+
+
+# kind -> {field: types} for fields that are type-checked when present
+# but NOT required: added after files of that kind already existed in
+# the wild (append-mode files span upgrades — a resumed run writes a
+# new-format header into a file whose old headers predate the field).
+OPTIONAL: dict[str, dict[str, Any]] = {
+    "run_start": {
+        "hostname": str,
+        "pid": int,
+    },
 }
 
 
@@ -158,6 +200,12 @@ def validate_row(row: dict, lineno: int | None = None) -> list[str]:
             errors.append(
                 f"{where}kind {kind!r} field {name!r}: expected "
                 f"{types}, got {type(row[name]).__name__}"
+            )
+    for name, types in OPTIONAL.get(kind, {}).items():
+        if name in row and not isinstance(row[name], types):
+            errors.append(
+                f"{where}kind {kind!r} optional field {name!r}: "
+                f"expected {types}, got {type(row[name]).__name__}"
             )
     return errors
 

@@ -1,0 +1,269 @@
+"""Stall watchdog — classifies hot-path silence and escalates.
+
+A wedged input pipeline, a hung device dispatch, and a backed-up
+MicroBatcher all look identical from outside: the process is alive and
+nothing moves.  The watchdog turns that silence into a *named* cause
+within a bounded delay, with zero work on the hot path itself: the hot
+paths already heartbeat the FlightRecorder (obs/flight.py ``note_*`` —
+a clock read and a locked dict store), and a single monitor thread
+polls those beats.
+
+Classification (separate thresholds, Config ``obs_watchdog_*``):
+
+* ``input_stall`` — the trainer's last phase note is ``input_stall``
+  and it has been silent past ``input_s``: the loop is starved.  The
+  health row carries the loader channel's age too, so a starving
+  trainer with a *beating* loader (transfer/backpressure problem) is
+  distinguishable from a dead input pipeline.
+* ``device_hang`` — last phase note is ``dispatch``/``device_block``/
+  ``h2d``/``checkpoint`` and silent past ``device_s``: the device (or
+  its dispatch queue, or the checkpoint write) is wedged.
+* ``serve_queue_stall`` — the serve channel is silent past ``serve_s``
+  WHILE work is pending (``set_pending`` callable); an idle batcher
+  never trips.
+
+Escalation per incident: trip → log line + ``health`` JSONL row +
+instant trace event; silence reaching ``ESCALATE_FACTOR`` × threshold →
+one flight dump (``<flight_out>`` with reason ``watchdog``).  Recovery
+(a fresh beat) emits a closing ``health`` row with cause
+``recovered:<original>`` so the stream records the stall's duration.
+
+Thread-safety (XF003): all incident state is mutated under
+``self._lock``; the monitor thread never touches device state or JAX
+at all (XF002 — no host syncs anywhere on this path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from xflow_tpu.obs.flight import FlightRecorder
+
+# phase-note detail -> (cause name, threshold key)
+_TRAIN_CAUSES = {
+    "input_stall": ("input_stall", "input"),
+    "dispatch": ("device_hang", "device"),
+    "device_block": ("device_hang", "device"),
+    "h2d": ("device_hang", "device"),
+    "checkpoint": ("checkpoint_stall", "device"),
+}
+
+ESCALATE_FACTOR = 2.0
+
+
+class Watchdog:
+    def __init__(
+        self,
+        flight: FlightRecorder,
+        input_s: float = 30.0,
+        device_s: float = 120.0,  # keep in sync with Config defaults
+        serve_s: float = 10.0,
+        poll_s: float = 0.0,
+        flight_out: str = "",
+        metrics_logger=None,
+        tracer=None,
+        log: Callable[[str], None] | None = None,
+    ):
+        if min(input_s, device_s, serve_s) <= 0:
+            raise ValueError("watchdog thresholds must be > 0")
+        self.flight = flight
+        self.thresholds = {
+            "input": input_s,
+            "device": device_s,
+            "serve": serve_s,
+        }
+        # poll fast enough to trip "within its threshold": a quarter of
+        # the tightest threshold, floored so a sub-ms test threshold
+        # doesn't spin the monitor
+        self.poll_s = poll_s if poll_s > 0 else max(
+            min(input_s, device_s, serve_s) / 4.0, 0.01
+        )
+        self.flight_out = flight_out
+        self.metrics_logger = metrics_logger
+        self.tracer = tracer
+        self._log = log if log is not None else (lambda s: None)
+        self._lock = threading.Lock()
+        # channel -> open incident {cause, threshold, t_trip, dumped}
+        self._incidents: dict[str, dict[str, Any]] = {}
+        self._pending: dict[str, Callable[[], bool]] = {}
+        self.trip_count = 0
+        self.dump_count = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def set_pending(self, channel: str, fn: Callable[[], bool]) -> None:
+        """Register a 'work is pending' probe: ``channel`` silence only
+        trips while ``fn()`` is True (an idle server is healthy)."""
+        with self._lock:
+            self._pending[channel] = fn
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="xflow-obs-watchdog", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            thread = self._thread
+            self._thread = None
+        self._stop.set()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def __enter__(self) -> "Watchdog":
+        self.start()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- monitor ------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.check()
+
+    def check(self, now: float | None = None) -> list[dict]:
+        """One monitor pass (the thread calls this every ``poll_s``;
+        tests call it directly).  Returns the health rows emitted."""
+        if now is None:
+            now = time.perf_counter()
+        rows = []
+        for channel in ("train", "serve"):
+            row = self._check_channel(channel, now)
+            if row is not None:
+                rows.append(row)
+        return rows
+
+    def _classify(self, channel: str, detail: str) -> tuple[str, float] | None:
+        """(cause, threshold seconds) for the channel's activity
+        ``detail``, or None when its silence is benign."""
+        if channel == "train":
+            if detail == "idle":
+                # the trainer parked itself between epochs/evals —
+                # silence here is the caller's time, not a stall
+                return None
+            cause, key = _TRAIN_CAUSES.get(
+                detail, (f"stall:{detail}", "device")
+            )
+            return cause, self.thresholds[key]
+        with self._lock:
+            pending = self._pending.get(channel)
+        if pending is not None and not pending():
+            return None  # idle, not stalled
+        return "serve_queue_stall", self.thresholds["serve"]
+
+    def _check_channel(self, channel: str, now: float) -> dict | None:
+        # age + detail read atomically: classifying a stale age against
+        # a just-transitioned phase's (tighter) threshold would trip
+        # spuriously
+        state = self.flight.channel_state(channel, now)
+        if state is None:
+            return None  # channel never started — nothing to watch
+        age, detail = state
+        with self._lock:
+            incident = self._incidents.get(channel)
+        verdict = self._classify(channel, detail)
+        if verdict is None or age < verdict[1]:
+            if incident is not None:
+                return self._recover(channel, incident, age)
+            return None
+        cause, threshold = verdict
+        if incident is None:
+            return self._trip(channel, cause, threshold, age)
+        with self._lock:
+            # track the deepest silence seen while the incident is
+            # open: the recovery row reports THIS as the stall's
+            # duration (at recovery time the fresh beat has already
+            # reset the channel's age)
+            incident["worst_age"] = max(incident["worst_age"], age)
+        if (
+            not incident["dumped"]
+            and self.flight_out
+            and age >= threshold * ESCALATE_FACTOR
+        ):
+            self._escalate(channel, incident, age)
+        return None
+
+    # -- incident transitions ----------------------------------------------
+
+    def _health_row(
+        self, channel: str, cause: str, threshold: float, age: float
+    ) -> dict:
+        row = {
+            "cause": cause,
+            "channel": channel,
+            "silence_seconds": round(age, 3),
+            "threshold_seconds": round(threshold, 3),
+            "detail": self.flight.last_detail(channel) or "",
+            "channels": self.flight.snapshot()["channels"],
+        }
+        if self.metrics_logger is not None:
+            self.metrics_logger.log("health", row)
+        return row
+
+    def _trip(
+        self, channel: str, cause: str, threshold: float, age: float
+    ) -> dict:
+        with self._lock:
+            self._incidents[channel] = {
+                "cause": cause,
+                "threshold": threshold,
+                "dumped": False,
+                "worst_age": age,
+            }
+            self.trip_count += 1
+        self._log(
+            f"watchdog: {cause} — {channel!r} silent {age:.1f}s "
+            f"(threshold {threshold:.1f}s, last activity "
+            f"{self.flight.last_detail(channel)!r})"
+        )
+        if self.tracer is not None:
+            self.tracer.instant(
+                "watchdog_trip", {"cause": cause, "channel": channel}
+            )
+        return self._health_row(channel, cause, threshold, age)
+
+    def _escalate(self, channel: str, incident: dict, age: float) -> None:
+        with self._lock:
+            if incident["dumped"]:
+                return
+            incident["dumped"] = True
+            self.dump_count += 1
+        path = self.flight.dump(self.flight_out, reason="watchdog")
+        self._log(
+            f"watchdog: {incident['cause']} persists ({age:.1f}s) — "
+            f"flight dump written to {path}"
+        )
+
+    def _recover(self, channel: str, incident: dict, age: float) -> dict:
+        with self._lock:
+            self._incidents.pop(channel, None)
+        # the stall's duration is the deepest silence observed while
+        # the incident was open — `age` here is the POST-recovery beat
+        # age (~one poll interval), useless as a duration
+        stalled = incident["worst_age"]
+        self._log(
+            f"watchdog: {channel!r} recovered from {incident['cause']} "
+            f"after ~{stalled:.1f}s"
+        )
+        if self.tracer is not None:
+            self.tracer.instant(
+                "watchdog_recovered",
+                {"cause": incident["cause"], "channel": channel},
+            )
+        return self._health_row(
+            channel,
+            f"recovered:{incident['cause']}",
+            incident["threshold"],
+            stalled,
+        )

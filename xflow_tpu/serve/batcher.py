@@ -46,10 +46,16 @@ class MicroBatcher:
         max_batch: int | None = None,
         registry: MetricsRegistry | None = None,
         metrics_logger=None,
+        flight=None,
     ):
         if max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
         self._engine = engine
+        # obs/flight.py heartbeat sink: one note_serve per coalesced
+        # batch; a watchdog with set_pending("serve", self.pending)
+        # then classifies silence-with-backlog as serve_queue_stall
+        self._flight = flight
+        self._busy = False
         self._max_wait = max_wait_ms / 1000.0
         self._max_batch = (
             max_batch if max_batch is not None else engine.buckets[-1]
@@ -95,6 +101,12 @@ class MicroBatcher:
 
     def score(self, keys, slots=None, vals=None) -> float:
         return float(self.submit(keys, slots, vals).result())
+
+    def pending(self) -> bool:
+        """Work is queued or in flight — the watchdog's serve-channel
+        gate (an idle batcher's silence is healthy, a backed-up one's
+        is a stall)."""
+        return self._busy or not self._q.empty()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -179,25 +191,36 @@ class MicroBatcher:
             item = self._q.get()
             if item is _STOP:
                 return
-            reqs = [item]
-            deadline = time.perf_counter() + self._max_wait
-            while len(reqs) < self._max_batch:
-                timeout = deadline - time.perf_counter()
-                if timeout <= 0:
-                    # deadline passed: take whatever is already queued,
-                    # but don't wait for more
-                    timeout = 0.0
-                try:
-                    nxt = self._q.get(timeout=timeout) if timeout else (
-                        self._q.get_nowait()
-                    )
-                except queue.Empty:
-                    break
-                if nxt is _STOP:
-                    stopping = True
-                    break
-                reqs.append(nxt)
-            self._run_batch(reqs)
+            # busy from the FIRST dequeue: a request riding the
+            # coalescing wait below is in flight even though the queue
+            # may be empty — pending() must not read it as idle
+            with self._submit_lock:
+                self._busy = True
+            try:
+                reqs = [item]
+                deadline = time.perf_counter() + self._max_wait
+                while len(reqs) < self._max_batch:
+                    timeout = deadline - time.perf_counter()
+                    if timeout <= 0:
+                        # deadline passed: take whatever is already
+                        # queued, but don't wait for more
+                        timeout = 0.0
+                    try:
+                        nxt = self._q.get(timeout=timeout) if timeout else (
+                            self._q.get_nowait()
+                        )
+                    except queue.Empty:
+                        break
+                    if nxt is _STOP:
+                        stopping = True
+                        break
+                    reqs.append(nxt)
+                self._run_batch(reqs)
+            finally:
+                with self._submit_lock:
+                    self._busy = False
+                if self._flight is not None:
+                    self._flight.note_serve("batch")
 
     def _run_batch(self, reqs: list) -> None:
         with self._swap_lock:

@@ -430,4 +430,11 @@ class PredictEngine:
                 garr = multihost_utils.global_array_to_host_local_array(
                     garr, self.mesh, self.step._bsharding.spec
                 )
-            return np.asarray(jax.device_get(garr))
+            out = np.asarray(jax.device_get(garr))
+        if self.obs.flight is not None:
+            # serve-channel heartbeat (obs/flight.py): one device call
+            # completed — the watchdog's "is scoring moving?" signal,
+            # tagged with the bucket it ran in (forensics for "which
+            # shape was in flight when serving wedged")
+            self.obs.flight.note_serve(f"execute:b{key[0]}")
+        return out

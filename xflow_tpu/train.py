@@ -129,6 +129,29 @@ def build_parser() -> argparse.ArgumentParser:
         "--obs-trace-capacity", type=int, dest="obs_trace_capacity",
         help="span ring-buffer size (newest N spans kept)",
     )
+    p.add_argument(
+        "--obs-flight-out", dest="obs_flight_out",
+        help="flight-recorder dump path: crash/hang forensics (recent "
+        "phases, batch shapes, thread stacks) written here atomically "
+        "on unhandled exception, preemption, or watchdog escalation; "
+        "read with `python -m xflow_tpu.obs doctor RUN --flight FILE`",
+    )
+    p.add_argument(
+        "--obs-watchdog", action="store_true", default=None,
+        dest="obs_watchdog",
+        help="enable the stall watchdog: classifies hot-loop silence "
+        "into input starvation / device hang, emits `health` JSONL "
+        "rows, escalates to a flight dump (docs/OBSERVABILITY.md "
+        "\"Diagnosing a sick run\")",
+    )
+    p.add_argument(
+        "--obs-watchdog-input-s", type=float, dest="obs_watchdog_input_s",
+        help="input-starvation silence threshold, seconds",
+    )
+    p.add_argument(
+        "--obs-watchdog-device-s", type=float, dest="obs_watchdog_device_s",
+        help="device-hang silence threshold, seconds",
+    )
     p.add_argument("--profile-dir", dest="profile_dir")
     p.add_argument("--profile-steps", type=int, dest="profile_steps")
     p.add_argument("--profile-start-step", type=int, dest="profile_start_step")

@@ -90,7 +90,12 @@ class Trainer:
         # NULL_OBS (zero per-step allocation).  Threaded into the step
         # (put_batch/dispatch phases) and every loader (parse/pack).
         self.obs = NULL_OBS
-        if cfg.metrics_out or cfg.obs_trace_out:
+        if (
+            cfg.metrics_out
+            or cfg.obs_trace_out
+            or cfg.obs_flight_out
+            or cfg.obs_watchdog
+        ):
             from xflow_tpu.obs import make_obs
 
             self.obs = make_obs(
@@ -101,12 +106,53 @@ class Trainer:
             )
         self.step.obs = self.obs
         self.metrics_logger = None
-        if cfg.metrics_out and self.host == 0:
+        if cfg.metrics_out:
             from xflow_tpu.utils.logging import MetricsLogger
 
+            # every host writes its own rank-suffixed file in
+            # multi-host runs; `python -m xflow_tpu.obs merge` combines
+            # them into one rank-tagged stream for `obs doctor`
+            path = cfg.metrics_out
+            if self.num_hosts > 1:
+                path = f"{path}-r{self.host}"
             self.metrics_logger = MetricsLogger(
-                cfg.metrics_out, run_header=self._run_header()
+                path, run_header=self._run_header()
             )
+        # Flight recorder + stall watchdog (obs/flight.py, watchdog.py):
+        # the recorder rides the live Obs so ShardLoader/PredictEngine
+        # heartbeat it; the watchdog monitor starts now and stops in
+        # close().  _flight_reason records WHY the run is ending so
+        # close() writes exactly one dump on the crash/preemption paths.
+        self._flight = None
+        self._watchdog = None
+        self._flight_reason: tuple[str, BaseException | None] | None = None
+        self._last_batch_shape: tuple | None = None
+        if self.obs.enabled and (cfg.obs_flight_out or cfg.obs_watchdog):
+            from xflow_tpu.obs.flight import FlightRecorder
+
+            self._flight = FlightRecorder(
+                capacity=cfg.obs_flight_events,
+                metrics_logger=self.metrics_logger,
+                registry=self.obs.registry,
+                tracer=self.obs.tracer if self.obs.tracer.enabled else None,
+                rank=self.host,
+            )
+            self.obs.flight = self._flight
+        if cfg.obs_watchdog and self._flight is not None:
+            from xflow_tpu.obs.watchdog import Watchdog
+
+            self._watchdog = Watchdog(
+                self._flight,
+                input_s=cfg.obs_watchdog_input_s,
+                device_s=cfg.obs_watchdog_device_s,
+                serve_s=cfg.obs_watchdog_serve_s,
+                poll_s=cfg.obs_watchdog_poll_s,
+                flight_out=self._flight_path(),
+                metrics_logger=self.metrics_logger,
+                tracer=self.obs.tracer if self.obs.tracer.enabled else None,
+                log=self._log,
+            )
+            self._watchdog.start()
         self._profiled = False
         self._preempted = False
         self._preempt_agreed = False
@@ -143,11 +189,67 @@ class Trainer:
             "model": self.cfg.model,
         }
 
+    def _flight_path(self) -> str:
+        path = self.cfg.obs_flight_out
+        if path and self.num_hosts > 1:
+            path = f"{path}-r{self.host}"
+        return path
+
+    def _pulse(self, phase: str) -> None:
+        """Trainer heartbeat: the main loop just entered ``phase``.
+        Feeds the flight recorder's ring AND the watchdog's liveness
+        view — one clock read + locked dict store, nothing device-side
+        (XF002)."""
+        if self._flight is not None:
+            self._flight.note_phase(phase, self._global_steps)
+
+    def _note_batch_shape(self, batch: Batch, shard_idx: int) -> None:
+        """Record the in-flight batch geometry, but only when it
+        CHANGES (static loader shapes mean ~one note per run; a new
+        shape right before a hang is exactly the forensic that points
+        at a recompile or a mis-sized external batch)."""
+        if self._flight is None:
+            return
+        shape = (batch.batch_size, batch.max_nnz, batch.hot_nnz)
+        if shape != self._last_batch_shape:
+            self._last_batch_shape = shape
+            self._flight.note_batch({
+                "rows": batch.batch_size,
+                "cold_nnz": batch.max_nnz,
+                "hot_nnz": batch.hot_nnz,
+                "shard": shard_idx,
+            })
+
+    def flight_dump(self, reason: str, exc: BaseException | None = None) -> None:
+        """Mark the run as dying for ``reason``; close() writes the
+        dump (once) as part of the flush path, so metrics flush and
+        dump ordering stay on the one exit road."""
+        if self._flight_reason is None:
+            self._flight_reason = (reason, exc)
+
     def close(self) -> None:
-        """Flush-and-close observability outputs: the metrics JSONL and
-        (when tracing) the Chrome trace export.  Idempotent.  train()
-        calls it on its exception and preemption paths; use the Trainer
-        as a context manager (or call this) to cover every other exit."""
+        """Flush-and-close observability outputs: stop the watchdog,
+        write the flight dump when a crash/preemption was recorded,
+        then the metrics JSONL and (when tracing) the Chrome trace
+        export.  Idempotent.  train() calls it on its exception and
+        preemption paths; use the Trainer as a context manager (or
+        call this) to cover every other exit."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if (
+            self._flight is not None
+            and self._flight_reason is not None
+            and self._flight_path()
+        ):
+            reason, exc = self._flight_reason
+            self._flight_reason = None  # one dump per incident
+            path = self._flight_path()
+            if self._watchdog is not None and self._watchdog.dump_count:
+                # the watchdog already dumped DURING the stall (stuck
+                # thread stacks — the forensic that matters); the
+                # exit-time dump must not overwrite it
+                path = f"{path}.exit"
+            self._flight.dump(path, reason, exc=exc)
         self._export_trace()
         if self.metrics_logger is not None:
             self.metrics_logger.close()
@@ -287,6 +389,7 @@ class Trainer:
             examples = 0
             for batch, resume in it:
                 examples += batch.num_real()
+                self._note_batch_shape(batch, si)
                 yield batch, si, resume
             dt = time.perf_counter() - t_shard
             if self.metrics_logger is not None:
@@ -468,10 +571,12 @@ class Trainer:
                     # with transfer-ahead/prefetch on, parse, pack and
                     # h2d all hide behind this wait; whatever doesn't
                     # overlap device time surfaces here
+                    self._pulse("input_stall")
                     with obs.phase("input_stall"):
                         batch, shard_idx, resume = next(it)
                 except StopIteration:
                     break
+                self._pulse("dispatch")
                 last_cursor = (shard_idx, resume)
                 if (
                     cfg.profile_dir
@@ -510,8 +615,10 @@ class Trainer:
                 self._stop_profile(
                     device_metrics[-1] if device_metrics else None
                 )
+            self._pulse("device_block")
             with obs.phase("device_block"):
                 host_metrics = jax.device_get(device_metrics)
+            self._pulse("idle")  # epoch compute over — silence is benign
         seen = float(sum(m["count"] for m in host_metrics))
         ll_sum = float(
             sum(m["logloss"] * m["count"] for m in host_metrics)
@@ -605,8 +712,9 @@ class Trainer:
                         f"examples/s={stats['examples_per_sec']:.0f}"
                     )
                 if stats.get("preempted"):
-                    # the process is about to exit for a restart: flush
-                    # the metrics file and trace NOW
+                    # the process is about to exit for a restart: dump
+                    # the flight record and flush metrics + trace NOW
+                    self.flight_dump("preemption")
                     self.close()
                     break
                 self.epoch += 1
@@ -619,8 +727,11 @@ class Trainer:
                     and self.epoch % self.cfg.eval_every_epochs == 0
                 ):
                     self.evaluate()
-        except BaseException:
-            # crash path: never lose buffered metrics rows or the trace
+        except BaseException as e:
+            # crash path: flight-dump the black box (active phase,
+            # thread stacks, recent state), then never lose buffered
+            # metrics rows or the trace
+            self.flight_dump("exception", exc=e)
             self.close()
             raise
         finally:
@@ -728,11 +839,14 @@ class Trainer:
             it = iter(self._synced_batches(batches()))
             while True:
                 try:
+                    self._pulse("input_stall")
                     with obs.phase("input_stall"):
                         batch, _, _ = next(it)
                 except StopIteration:
                     break
+                self._pulse("h2d")
                 arrays = self.step.put_batch(batch)  # books 'h2d' inline
+                self._pulse("dispatch")
                 with obs.phase("dispatch"):
                     garr = self.step.predict(self.state, arrays)
                 if self.num_hosts > 1:
@@ -743,6 +857,7 @@ class Trainer:
                     garr = multihost_utils.global_array_to_host_local_array(
                         garr, self.mesh, self.step._bsharding.spec
                     )
+                self._pulse("device_block")
                 with obs.phase("device_block"):
                     pctr = np.asarray(jax.device_get(garr))
                 acc.add(batch.labels, pctr, batch.weights)
@@ -824,6 +939,7 @@ class Trainer:
         self._log(f"logloss: {ll:.6f}\tauc = {auc:.6f}\ttp = {pos} fp = {n - pos}")
         if self.metrics_logger is not None:
             self.metrics_logger.log("eval", result)
+        self._pulse("idle")  # eval over — watchdog silence is benign
         return result
 
     # -- checkpointing -----------------------------------------------------
@@ -831,6 +947,7 @@ class Trainer:
     def save(self, shard_idx: int = 0, offset: int = 0) -> str | None:
         if not self.cfg.checkpoint_dir:
             return None
+        self._pulse("checkpoint")
         # Per-host cursors: shard_idx/offset are HOST-LOCAL (each host
         # walks its own ``i % num_hosts`` shard subset), so the manifest
         # records every host's position; a host restores its own.
@@ -855,13 +972,20 @@ class Trainer:
             "shard": cursors[0]["shard"],
             "offset": cursors[0]["offset"],
         }
-        return save_checkpoint(
+        path = save_checkpoint(
             self.cfg.checkpoint_dir,
             self.state,
             cursor,
             self.cfg.to_json(),
             keep=self.cfg.checkpoint_keep,
         )
+        if self._flight is not None:
+            self._flight.note_checkpoint(self._global_steps)
+        # close the 'checkpoint' activity: after a post-epoch save the
+        # trainer may sit in caller code indefinitely, and lingering
+        # 'checkpoint' as the last note would read as checkpoint_stall
+        self._pulse("idle")
+        return path
 
     def restore(self) -> dict | None:
         """Resume from the latest checkpoint if one exists; returns the

@@ -35,7 +35,17 @@ class MetricsLogger:
         self._t0 = time.time()
         self.closed = False
         if run_header is not None:
-            header = {"time_unix": round(self._t0, 3)}
+            import os
+            import socket
+
+            # hostname/pid stamped HERE so every emitter (trainer,
+            # serve bench, smoke scripts) gets them for free — `obs
+            # merge`/`doctor` label hosts in multi-host runs by them
+            header = {
+                "time_unix": round(self._t0, 3),
+                "hostname": socket.gethostname(),
+                "pid": os.getpid(),
+            }
             header.update(run_header)
             self.log("run_start", header)
 
