@@ -348,12 +348,14 @@ def bench_e2e(devices, cfg, data_path: str, result: dict, remap=None) -> None:
 
     # -- packed-batch cache path (io/packed.py): the steady-state story.
     # Text parses ONCE into device-ready batches; epochs 2..N stream
-    # them at memory speed.  Cached on disk keyed by config + remap.
+    # them at memory speed.  Cached on disk keyed by config + remap;
+    # the v2 cache stores PRE-COMPACTED records (io/compact.py), so the
+    # steady-state feed pays zero per-batch compaction or wire packing.
     from xflow_tpu.io import packed as packed_mod
 
     digest = (packed_mod.remap_digest(remap) or "none")[:12]
     pk_path = (
-        f"{data_path}.pk-b{cfg.batch_size}-k{cfg.max_nnz}"
+        f"{data_path}.pk2-b{cfg.batch_size}-k{cfg.max_nnz}"
         f"-t{cfg.table_size_log2}-h{cfg.hot_size_log2}.{cfg.hot_nnz}"
         f"-s{cfg.seed}-r{digest}"
     )
@@ -383,33 +385,43 @@ def bench_e2e(devices, cfg, data_path: str, result: dict, remap=None) -> None:
         remap=remap,
         hot_size=cfg.hot_size,
         hot_nnz=cfg.hot_nnz if cfg.hot_size else 0,
+        emit_compact=step.dict_wire,
     )
+    result["wire_format"] = step.wire_format
     # host-only read rate (epoch-2+ feed capacity, no device).  Records
-    # are mmap-backed views, so an untouched field costs nothing; to
-    # keep the metric honest this loop runs the numpy half of the
-    # compact wire — by construction exactly the per-batch work the
-    # training feed performs (parallel/step.py::compact_wire_np).
-    from xflow_tpu.parallel.step import compact_wire_np
-
+    # are mmap-backed views; to keep the metric honest this loop runs
+    # the numpy half of put_batch — by construction exactly the
+    # per-batch work the training feed performs
+    # (parallel/step.py::host_wire_np).
     t0 = time.perf_counter()
     n = 0
     for batch, _ in pk_loader.iter_batches():
-        wire = compact_wire_np(
-            batch, ship_slots=step._ship_slots, hot_u16=step._hot_u16
-        )
-        n += int(wire["weights_u8"].sum())
+        step.host_wire_np(batch)
+        n += batch.num_real()
     dt = time.perf_counter() - t0
     result["packed_read_examples_per_sec"] = round(n / dt, 1)
     # e2e with transfer-ahead (trainer._transfer_ahead structure): the
-    # first timed pass on the tunneled link warms slowly, so run two and
-    # report the steady-state (second) pass — that IS the epoch regime.
+    # first timed pass on the tunneled link warms slowly (and compiles
+    # the full- and tail-batch shape buckets), so run two and report
+    # the steady-state (second) pass — that IS the epoch regime.  The
+    # second pass must hit the executable cache only: e2e_recompiles
+    # counts programs compiled DURING it (acceptance: 0 — the dict
+    # wire's plane_cap bucketing keeps steady shapes on one program).
     from concurrent.futures import ThreadPoolExecutor
+
+    def train_cache_size():
+        try:
+            return int(step.train._cache_size())
+        except Exception:
+            return -1
 
     best = 0.0
     best_link = 0.0
     wire_bytes_per_batch = None
-    with ThreadPoolExecutor(1) as ex:
-        for _ in range(2):
+    compaction_ratio = None
+    with ThreadPoolExecutor(2) as ex:
+        for pass_i in range(2):
+            cache_before = train_cache_size()
             t0 = time.perf_counter()
             n = 0
             sent = 0
@@ -419,24 +431,13 @@ def bench_e2e(devices, cfg, data_path: str, result: dict, remap=None) -> None:
                 if wire_bytes_per_batch is None:
                     # what actually crosses the link per dispatch (the
                     # bytes x link-MB/s reconciliation, VERDICT r4 #6)
-                    if step.compact_wire:
-                        arrays = compact_wire_np(
-                            batch,
-                            ship_slots=step._ship_slots,
-                            hot_u16=step._hot_u16,
-                        )
-                        wire_bytes_per_batch = sum(
-                            v.nbytes for v in arrays.values()
-                        )
-                    else:
-                        wire_bytes_per_batch = sum(
-                            a.nbytes
-                            for a in (
-                                batch.keys, batch.slots, batch.vals,
-                                batch.mask, batch.labels, batch.weights,
-                                batch.hot_keys, batch.hot_slots,
-                                batch.hot_vals, batch.hot_mask,
-                            )
+                    wire, cb = step.host_wire_np(batch)
+                    wire_bytes_per_batch = sum(
+                        v.nbytes for v in wire.values()
+                    )
+                    if cb is not None and cb.n_dict:
+                        compaction_ratio = round(
+                            cb.n_cold / max(cb.cold_touched, 1), 3
                         )
                 pending.append((ex.submit(step.put_batch, batch), batch.num_real()))
                 if len(pending) > 2:
@@ -448,16 +449,24 @@ def bench_e2e(devices, cfg, data_path: str, result: dict, remap=None) -> None:
                 n += cnt
             jax.device_get(state["tables"]["w"]["param"][:1, 0])
             dt = time.perf_counter() - t0
+            if pass_i == 1:
+                delta = train_cache_size() - cache_before
+                result["e2e_recompiles"] = (
+                    delta if cache_before >= 0 else None
+                )
             eps = n / dt
             if eps > best:
                 best = eps
                 # actual bytes shipped per second this pass (every
-                # dispatched batch ships the full padded wire, so count
-                # batches, not real examples — a real-example scaling
-                # would read low by the tail-batch pad fraction)
+                # dispatched batch ships the same bucketed wire, so
+                # count batches, not real examples — a real-example
+                # scaling would read low by the tail-batch pad
+                # fraction)
                 if wire_bytes_per_batch:
                     best_link = sent * wire_bytes_per_batch / dt
     result["e2e_packed_examples_per_sec"] = round(best, 1)
+    if compaction_ratio is not None:
+        result["compaction_ratio"] = compaction_ratio
     if wire_bytes_per_batch:
         result["wire_bytes_per_batch"] = wire_bytes_per_batch
         result["wire_bytes_per_example"] = round(
@@ -531,6 +540,12 @@ def main() -> None:
         hot_size_log2=12,
         hot_nnz=32,
         num_devices=1,
+        # cold_consolidate stays OFF: the dict wire ships the cold
+        # head's consolidation plan for free (no device argsort), but
+        # for LR's scalar (D=1) scatters even the free plan loses to
+        # the direct scatter-add (measured +15% step time on CPU) —
+        # consolidation pays for multi-lane tables (fm/mvm/ffm), see
+        # docs/PERF.md "Wire format and compaction"
     )
     try:
         accel = [d for d in jax.devices() if d.platform != "cpu"]

@@ -62,8 +62,24 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 1 if args.strict else 0
     latest = usable[-1]
+    # A degraded run (CPU fallback where an accelerator was expected —
+    # bench.py _finalize_artifact) must never become the bar: its
+    # "value" measures the container, not the code.  Baseline
+    # candidates are the non-degraded priors; when every prior is
+    # degraded (a whole stretch of broken tunnels) fall back to all of
+    # them rather than skipping the check entirely.
+    priors = [
+        p_ for p_ in usable[:-1]
+        if not load_bench_result(p_).get("degraded")
+    ]
+    if not priors:
+        print(
+            "WARNING: every prior bench artifact is degraded — "
+            "comparing against degraded baselines"
+        )
+        priors = usable[:-1]
     best_prior = max(
-        usable[:-1],
+        priors,
         key=lambda p_: float(load_bench_result(p_)["value"]),
     )
     print(f"comparing latest {latest} against best prior {best_prior}:")

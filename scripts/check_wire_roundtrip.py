@@ -1,0 +1,158 @@
+"""Wire/compaction round-trip lint: the packed-v2 cache and the host
+compaction stage (io/compact.py) must be lossless end to end.
+
+Builds a deterministic toy shard, packs it into a v2 cache
+(write -> read), and asserts:
+
+* every record read back EXPANDS byte-exact to the batch the text
+  loader assembles at the same config (write -> read -> expand);
+* re-compacting the expanded batch reproduces the record's planes
+  exactly (read -> compact fixed point — the dedup kernel and plane
+  capacities are deterministic);
+* the dict wire's metrics rows validate against obs/schema.py — a
+  toy training run emits a ``wire`` row and ``obs validate`` accepts
+  the file (the XF004 schema-drift gate covers the emitting call site
+  statically; this covers the emitted values).
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python scripts/check_wire_roundtrip.py
+
+Wired into tier-1 via tests/test_compact.py::
+test_check_wire_roundtrip_script.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PLANES = (
+    "cu", "ci", "ct", "cf", "cc", "h8", "hx", "hxh", "hf", "hc",
+    "lb", "wb", "cs", "hs",
+)
+
+
+def check_roundtrip(root: str) -> list[str]:
+    from tests.gen_data import generate_dataset
+    from xflow_tpu.io import packed
+    from xflow_tpu.io.compact import compact_batch
+    from xflow_tpu.io.loader import ShardLoader
+
+    errors: list[str] = []
+    ds = generate_dataset(
+        os.path.join(root, "data"),
+        num_train_shards=1,
+        lines_per_shard=300,
+        num_fields=10,
+        vocab_per_field=8,
+        seed=11,
+        scale=3.0,
+    )
+    src = ds.train_prefix + "-00000"
+    dst = os.path.join(root, "golden-v2")
+    table = 1 << 14
+    hot_size, hot_nnz = 256, 6
+    rng = np.random.default_rng(5)
+    remap = rng.permutation(table).astype(np.int32)
+    kw = dict(
+        batch_size=64, max_nnz=24, table_size=table,
+        hot_size=hot_size, hot_nnz=hot_nnz, remap=remap,
+    )
+    meta = packed.convert_shard(src, dst, fmt="v2", block_mib=0.01, **kw)
+    text = list(ShardLoader(src, block_mib=1, **kw).iter_batches())
+    with open(dst, "rb") as f:
+        records = list(packed.iter_compact_batches(f))
+    if len(records) != len(text) or meta["batches"] != len(text):
+        return [
+            f"record count mismatch: {len(records)} records vs "
+            f"{len(text)} text batches"
+        ]
+    fields = (
+        "keys", "slots", "vals", "mask", "labels", "weights",
+        "hot_keys", "hot_slots", "hot_vals", "hot_mask",
+    )
+    for i, ((tb, _), (cb, _, _)) in enumerate(zip(text, records)):
+        eb = cb.expand()
+        for fld in fields:
+            a, b = getattr(tb, fld), getattr(eb, fld)
+            if a.dtype != b.dtype or not np.array_equal(a, b):
+                errors.append(
+                    f"record {i}: expand()[{fld}] != text loader batch"
+                )
+        cb2 = compact_batch(eb, table, hot_size)
+        for pl in PLANES:
+            if not np.array_equal(getattr(cb, pl), getattr(cb2, pl)):
+                errors.append(
+                    f"record {i}: re-compacted plane {pl} != stored "
+                    "record (compaction not a fixed point)"
+                )
+    return errors
+
+
+def check_wire_metrics(root: str) -> list[str]:
+    """Toy train with metrics on: the dict wire must emit a ``wire``
+    row that ``obs validate`` (the schema) accepts."""
+    from tests.gen_data import generate_dataset
+    from xflow_tpu.config import Config
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.trainer import Trainer
+
+    ds = generate_dataset(
+        os.path.join(root, "wdata"),
+        num_train_shards=1,
+        lines_per_shard=200,
+        num_fields=10,
+        vocab_per_field=8,
+        seed=3,
+        scale=3.0,
+    )
+    out = os.path.join(root, "metrics.jsonl")
+    cfg = Config(
+        train_path=ds.train_prefix, model="lr", epochs=1,
+        batch_size=64, table_size_log2=14, max_nnz=24, num_devices=1,
+        metrics_out=out,
+    )
+    with Trainer(cfg) as t:
+        assert t.step.dict_wire, "toy config should be dict-eligible"
+        t.train()
+    rows = load_jsonl(out)
+    errors = validate_rows(rows)
+    wire = [r for r in rows if r.get("kind") == "wire"]
+    if not wire:
+        errors.append("toy run emitted no 'wire' metrics row")
+    for r in wire:
+        if r.get("format") != "dict":
+            errors.append(f"wire row format {r.get('format')!r} != 'dict'")
+        if not r.get("wire_bytes_per_example", 0) > 0:
+            errors.append("wire row has no positive wire_bytes_per_example")
+        if not r.get("compaction_ratio", 0) >= 1.0:
+            errors.append("wire row compaction_ratio < 1")
+    return errors
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as root:
+        errors = check_roundtrip(root)
+        errors += check_wire_metrics(root)
+    for e in errors:
+        print(f"FAIL: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print(
+        "OK: packed-v2 write->read->expand byte-exact, "
+        "read->compact fixed point, wire metrics schema-valid"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,404 @@
+"""Host-side batch compaction (io/compact.py) and the dictionary wire
+(Config.wire_dedup): compaction must round-trip loader batches
+byte-exact, the native and numpy dedup kernels must agree, plane
+capacities must bucket deterministically (compile_count stays flat),
+and training/prediction over the dict wire must match the plain wire —
+compression changes what crosses the link, never the math."""
+
+import subprocess
+import sys
+import os
+
+import numpy as np
+import pytest
+import jax
+
+from xflow_tpu.config import Config
+from xflow_tpu.io.batch import make_batch
+from xflow_tpu.io.compact import (
+    DICT_CAP,
+    CompactBatch,
+    compact_batch,
+    dedup_select,
+    plane_cap,
+)
+
+from tests.test_binary import batches_equal, make_loader
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _numpy_dedup(keys, cap):
+    """Force the numpy fallback path regardless of the native build."""
+    import unittest.mock as mock
+
+    from xflow_tpu import native
+
+    with mock.patch.object(native, "has_dict_encode", lambda: False):
+        return dedup_select(keys, cap)
+
+
+def _decode(keys, uniq, codes):
+    """Per-element keys implied by a (uniq, codes) encoding."""
+    m = codes != 0xFFFFFFFF
+    got = keys.copy()
+    if m.any():
+        got[m] = uniq[codes[m].astype(np.int64)]
+    return got, m
+
+
+# -- kernel ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dist", ["random", "zipf"])
+def test_dedup_select_native_numpy_parity(dist):
+    """Same dictionary SET and same per-element tier on both kernel
+    implementations (within-dictionary order is free), and both
+    encodings decode back to the input keys."""
+    from xflow_tpu import native
+
+    rng = np.random.default_rng(3)
+    if dist == "random":
+        keys = rng.integers(0, 1 << 22, 40000).astype(np.int64)
+    else:
+        keys = (rng.zipf(1.3, 40000) - 1).astype(np.int64)
+    for cap in (64, 1024, DICT_CAP):
+        u_np, c_np = _numpy_dedup(keys, cap)
+        assert len(u_np) <= cap
+        d_np, m_np = _decode(keys, u_np, c_np)
+        np.testing.assert_array_equal(d_np, keys)
+        if not (native.available() and native.has_dict_encode()):
+            continue
+        u_nat, c_nat = native.native_dict_encode(keys, cap)
+        assert set(u_nat.tolist()) == set(u_np.tolist())
+        d_nat, m_nat = _decode(keys, u_nat, c_nat)
+        np.testing.assert_array_equal(d_nat, keys)
+        np.testing.assert_array_equal(m_nat, m_np)
+
+
+def test_dedup_select_small_fits_whole_dictionary():
+    keys = np.asarray([5, 5, 9, 5, 9, 7], np.int64)
+    uniq, codes = _numpy_dedup(keys, DICT_CAP)
+    assert sorted(uniq.tolist()) == [5, 7, 9]
+    assert (codes != 0xFFFFFFFF).all()
+    got, _ = _decode(keys, uniq, codes)
+    np.testing.assert_array_equal(got, keys)
+
+
+def test_dedup_select_threshold_caps_dictionary():
+    """With more unique keys than cap, the dictionary keeps the
+    most-duplicated ones (count >= threshold) and the tail codes as
+    0xFFFFFFFF."""
+    rng = np.random.default_rng(0)
+    hot = np.repeat(np.arange(10, dtype=np.int64), 50)
+    tail = rng.integers(1000, 1 << 30, 500).astype(np.int64)
+    keys = np.concatenate([hot, tail])
+    rng.shuffle(keys)
+    uniq, codes = _numpy_dedup(keys, 16)
+    assert set(np.arange(10).tolist()) <= set(uniq.tolist())
+    assert len(uniq) <= 16
+    got, covered = _decode(keys, uniq, codes)
+    np.testing.assert_array_equal(got, keys)
+    assert covered.sum() >= 500  # the hot head is covered
+
+
+# -- capacities ------------------------------------------------------------
+
+
+def test_plane_cap_bucketing():
+    slots = 131072 * 16
+    g = max(256, slots // 32)
+    assert plane_cap(0, slots) == 0
+    assert plane_cap(1, slots) == g
+    assert plane_cap(g, slots) == g
+    assert plane_cap(g + 1, slots) == 2 * g
+    assert plane_cap(slots, slots) == slots
+    assert plane_cap(slots - 1, slots) == slots  # never exceeds slots
+    # nearby batch sizes share one bucket -> one compiled program
+    assert plane_cap(g + 5, slots) == plane_cap(g + g // 2, slots)
+
+
+# -- round trip ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("hot", [False, True])
+def test_compact_roundtrip_loader_batches(toy_dataset, hot):
+    """compact -> expand is byte-exact for every loader-produced batch,
+    including the zero-padded partial tail batch."""
+    src = toy_dataset.train_prefix + "-00000"
+    kw = dict(hot_size=256, hot_nnz=6) if hot else {}
+    if hot:
+        rng = np.random.default_rng(3)
+        kw["remap"] = rng.permutation(1 << 14).astype(np.int32)
+    loader = make_loader(src, **kw)
+    n = 0
+    for batch, _ in loader.iter_batches():
+        cb = compact_batch(batch, 1 << 14, 256 if hot else 0)
+        batches_equal(batch, cb.expand())
+        assert cb.num_real() == batch.num_real()
+        np.testing.assert_array_equal(cb.labels, batch.labels)
+        np.testing.assert_array_equal(cb.weights, batch.weights)
+        n += 1
+    assert n > 2
+
+
+def test_compact_roundtrip_all_padding():
+    """An all-padding batch (every key sentinel/masked) compacts to
+    empty planes and expands back to zeros."""
+    b, k = 8, 6
+    z_i = np.zeros((b, k), np.int32)
+    z_f = np.zeros((b, k), np.float32)
+    batch = make_batch(
+        z_i, z_i, z_f, z_f,
+        np.zeros(b, np.float32), np.zeros(b, np.float32),
+    )
+    cb = compact_batch(batch, 1 << 14, 0)
+    assert cb.n_cold == 0 and cb.n_dict == 0 and cb.num_real() == 0
+    batches_equal(batch, cb.expand())
+
+
+def test_compact_wire_is_smaller_and_fixed_point(toy_dataset):
+    """The wire is smaller than the plain compact wire's planes, and
+    compact(expand(cb)) reproduces cb's planes exactly (the packed-v2
+    fixed point)."""
+    from xflow_tpu.parallel.step import compact_wire_np
+
+    src = toy_dataset.train_prefix + "-00000"
+    loader = make_loader(src)
+    batch, _ = next(iter(loader.iter_batches()))
+    cb = compact_batch(batch, 1 << 14, 0)
+    old = sum(
+        v.nbytes for v in compact_wire_np(batch, ship_slots=True).values()
+    )
+    assert cb.wire_nbytes(ship_slots=True) < old
+    cb2 = compact_batch(cb.expand(), 1 << 14, 0)
+    for f in (
+        "cu", "ci", "ct", "cf", "cc", "h8", "hx", "hxh", "hf", "hc",
+        "lb", "wb", "cs", "hs",
+    ):
+        np.testing.assert_array_equal(
+            getattr(cb, f), getattr(cb2, f), err_msg=f
+        )
+
+
+# -- validation ------------------------------------------------------------
+
+
+def test_compact_rejects_value_batches():
+    b = make_batch(
+        np.zeros((2, 3), np.int32), np.zeros((2, 3), np.int32),
+        np.asarray([[0.5, 1, 1], [1, 1, 1]], np.float32),
+        np.ones((2, 3), np.float32),
+        np.zeros(2, np.float32), np.ones(2, np.float32),
+    )
+    with pytest.raises(ValueError, match="binary features"):
+        compact_batch(b, 1 << 14, 0)
+
+
+def test_holey_rows_compact_semantically_but_not_strictly():
+    """Rows with interior padding (mask holes) still ride the dict
+    wire — entries re-compact leftward with their triplets intact
+    (models are permutation-invariant over the feature axis) — but the
+    packed-v2 writer's strict_layout contract refuses them, because
+    byte-exact round-trip is impossible."""
+    mask = np.asarray([[1, 0, 1]], np.float32)
+    b = make_batch(
+        np.asarray([[3, 0, 5]], np.int32),
+        np.asarray([[1, 0, 2]], np.int32),
+        mask.copy(), mask,
+        np.zeros(1, np.float32), np.ones(1, np.float32),
+    )
+    eb = compact_batch(b, 1 << 14, 0).expand()
+    np.testing.assert_array_equal(eb.keys, [[3, 5, 0]])
+    np.testing.assert_array_equal(eb.slots, [[1, 2, 0]])
+    np.testing.assert_array_equal(eb.mask, [[1, 1, 0]])
+    with pytest.raises(ValueError, match="left-compacted"):
+        compact_batch(b, 1 << 14, 0, strict_layout=True)
+
+
+def test_compact_rejects_out_of_range_keys():
+    mask = np.ones((1, 2), np.float32)
+    b = make_batch(
+        np.asarray([[3, 40000]], np.int32), np.zeros((1, 2), np.int32),
+        mask.copy(), mask,
+        np.zeros(1, np.float32), np.ones(1, np.float32),
+    )
+    with pytest.raises(ValueError, match="table_size"):
+        compact_batch(b, 1 << 14, 0)
+
+
+# -- wire parity on device -------------------------------------------------
+
+
+def _train_once(cfg, batch):
+    from xflow_tpu.models import make_model
+    from xflow_tpu.optim import make_optimizer
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.parallel.step import TrainStep, init_state
+
+    mesh = make_mesh(1)
+    model, opt = make_model(cfg), make_optimizer(cfg)
+    step = TrainStep(model, opt, cfg, mesh)
+    state = init_state(model, opt, cfg, mesh)
+    state, m = step.train(state, step.put_batch(batch))
+    pctr = step.predict(state, step.put_batch(batch))
+    return step, jax.device_get(state["tables"]), np.asarray(pctr)
+
+
+@pytest.mark.parametrize("model", ["lr", "mvm"])
+@pytest.mark.parametrize("cold_consolidate", [False, True])
+def test_dict_wire_matches_plain_wire(model, cold_consolidate):
+    """One train step + predict over the dict wire equals the plain
+    compact wire to float tolerance, with and without the shipped
+    consolidation plan (cold_consolidate arms the indexed scatter)."""
+    rng = np.random.default_rng(11)
+    b, k = 64, 24
+    nnz = rng.integers(1, k, b)
+    mask = (np.arange(k)[None, :] < nnz[:, None]).astype(np.float32)
+    keys = np.where(
+        mask > 0, rng.integers(0, 1 << 14, (b, k)), 0
+    ).astype(np.int32)
+    head = rng.integers(0, 64, (b, k)).astype(np.int32)
+    keys = np.where((rng.random((b, k)) < 0.5) & (mask > 0), head, keys)
+    slots = np.where(mask > 0, rng.integers(0, 8, (b, k)), 0).astype(
+        np.int32
+    )
+    labels = (rng.random(b) < 0.4).astype(np.float32)
+    weights = (np.arange(b) < 60).astype(np.float32)
+    batch = make_batch(
+        keys, slots, mask.copy(), mask, labels * weights, weights,
+        1 << 8, 8,
+    )
+    kw = dict(
+        model=model, batch_size=b, table_size_log2=14, max_nnz=16,
+        max_fields=8, num_devices=1, hot_size_log2=8, hot_nnz=8,
+        cold_consolidate=cold_consolidate,
+    )
+    step_off, tables_off, pctr_off = _train_once(
+        Config(wire_dedup="off", **kw), batch
+    )
+    step_on, tables_on, pctr_on = _train_once(
+        Config(wire_dedup="on", **kw), batch
+    )
+    assert not step_off.dict_wire and step_on.dict_wire
+    assert step_on.wire_format == "dict"
+    jax.tree.map(
+        lambda a, c: np.testing.assert_allclose(
+            a, c, rtol=1e-5, atol=1e-6
+        ),
+        tables_off,
+        tables_on,
+    )
+    np.testing.assert_allclose(pctr_off, pctr_on, rtol=1e-5, atol=1e-6)
+
+
+def test_dict_wire_eligibility_gates():
+    common = dict(batch_size=64, table_size_log2=14, num_devices=1)
+    from xflow_tpu.models import make_model
+    from xflow_tpu.optim import make_optimizer
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.parallel.step import TrainStep
+
+    def mk(**kw):
+        cfg = Config(**common, **kw)
+        return TrainStep(
+            make_model(cfg), make_optimizer(cfg), cfg, make_mesh(1)
+        )
+
+    assert mk(model="lr").dict_wire
+    assert mk(model="mvm").dict_wire
+    # numeric mode carries values -> no compaction
+    assert not mk(model="lr", hash_mode=False).dict_wire
+    # u8 count planes bound the row widths
+    assert not mk(model="lr", max_nnz=300).dict_wire
+    # multi-device mesh: stream planes have no batch-axis sharding
+    cfg = Config(
+        model="lr", batch_size=64, table_size_log2=14, num_devices=2
+    )
+    step = TrainStep(
+        make_model(cfg), make_optimizer(cfg), cfg, make_mesh(2)
+    )
+    assert not step.dict_wire
+    with pytest.raises(ValueError, match="wire_dedup"):
+        mk(model="lr", hash_mode=False, wire_dedup="on")
+
+
+def test_serve_engine_pins_dict_wire_off(toy_dataset):
+    """Serving must keep content-independent wire shapes (the
+    one-compile-per-bucket guarantee), so the engine disables the
+    dict wire regardless of eligibility."""
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.trainer import Trainer
+
+    cfg = Config(
+        model="lr", train_path=toy_dataset.train_prefix,
+        batch_size=64, table_size_log2=14, max_nnz=24, num_devices=1,
+        epochs=1,
+    )
+    t = Trainer(cfg)
+    assert t.step.dict_wire  # the training feed does compact
+    eng = PredictEngine(cfg, t.state, buckets=(1, 8))
+    assert not eng.step.dict_wire
+    eng.warm()
+    n = eng.compile_count
+    eng.predict(eng.featurize_raw([np.asarray([1, 2, 3])]))
+    assert eng.compile_count == n
+    t.close()
+
+
+# -- tier-1 wiring ---------------------------------------------------------
+
+
+def test_dedup_select_pathological_cap_truncates():
+    """More than dict_cap keys EACH repeating > dict_cap times (so the
+    count histogram can't separate them): selection truncates to
+    dict_cap instead of overflowing the capped planes."""
+    keys = np.repeat(np.arange(9, dtype=np.int64), 6)  # 9 keys x 6 > cap 4
+    uniq, codes = _numpy_dedup(keys, 4)
+    assert len(uniq) <= 4
+    got, _ = _decode(keys, uniq, codes)
+    np.testing.assert_array_equal(got, keys)
+
+
+def test_engine_serves_wire_dedup_on_config_on_multi_device_mesh():
+    """A wire_dedup='on' training config must still serve on a
+    multi-device mesh: the engine overrides the step's wire, and the
+    digest-locked artifact config keeps its identity."""
+    from xflow_tpu.models import make_model
+    from xflow_tpu.optim import make_optimizer
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.parallel.step import TrainStep, init_state
+    from xflow_tpu.serve.engine import PredictEngine
+
+    cfg = Config(
+        model="lr", batch_size=64, table_size_log2=14, max_nnz=16,
+        num_devices=1, wire_dedup="on",
+    )
+    mesh2 = make_mesh(2)
+    state = init_state(
+        make_model(cfg), make_optimizer(cfg), cfg, mesh2
+    )
+    eng = PredictEngine(
+        cfg, state, mesh=mesh2, buckets=(2,), warm=False
+    )
+    assert not eng.step.dict_wire
+    assert eng.cfg.wire_dedup == "on"  # artifact identity untouched
+    assert eng.digest == cfg.digest()
+    out = eng.predict(eng.featurize_raw([np.asarray([1, 2, 3])]))
+    assert out.shape == (1,)
+
+
+def test_check_wire_roundtrip_script():
+    """Tier-1 wiring for scripts/check_wire_roundtrip.py (same pattern
+    as check_metrics_schema/check_serve_smoke)."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "check_wire_roundtrip.py"),
+        ],
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

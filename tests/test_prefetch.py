@@ -1,0 +1,94 @@
+"""Prefetch lifecycle (io/loader.py::_PrefetchIter): the producer
+thread must die on explicit close() — including when the consumer
+abandons the iterator mid-shard — not whenever the GC notices, and
+Trainer.close() must close every prefetch it spawned."""
+
+import time
+
+import numpy as np
+
+from xflow_tpu.config import Config
+from xflow_tpu.io.loader import ShardLoader, _PrefetchIter
+from xflow_tpu.trainer import Trainer
+
+
+def _wait_dead(it, timeout=5.0) -> bool:
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if not it.alive:
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_prefetch_close_stops_abandoned_producer(toy_dataset):
+    """Consumer takes ONE item and walks away; close() must stop the
+    producer even while it is blocked on a full queue."""
+    loader = ShardLoader(
+        toy_dataset.train_prefix + "-00000",
+        batch_size=16, max_nnz=24, table_size=1 << 14, block_mib=1,
+    )
+    it = loader.prefetch(depth=1)
+    batch, _ = next(it)
+    assert batch.num_real() == 16
+    assert it.alive  # producer blocked on the depth-1 queue
+    it.close()
+    assert _wait_dead(it)
+    # closed iterator is exhausted, not wedged
+    assert list(it) == []
+
+
+def test_prefetch_close_idempotent_and_context_manager(toy_dataset):
+    loader = ShardLoader(
+        toy_dataset.train_prefix + "-00000",
+        batch_size=16, max_nnz=24, table_size=1 << 14, block_mib=1,
+    )
+    with loader.prefetch(depth=2) as it:
+        next(it)
+    assert _wait_dead(it)
+    it.close()  # second close is a no-op
+
+    # depth 0 degrades to a synchronous passthrough with the same
+    # close() surface
+    it0 = loader.prefetch(depth=0)
+    next(it0)
+    it0.close()
+    assert list(it0) == []
+
+
+def test_prefetch_exception_propagates(tmp_path):
+    def boom():
+        yield 1
+        raise RuntimeError("producer exploded")
+
+    it = _PrefetchIter(boom(), depth=2)
+    assert next(it) == 1
+    try:
+        next(it)
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError:
+        pass
+    assert _wait_dead(it)
+
+
+def test_trainer_close_stops_live_prefetch(toy_dataset):
+    """Abandon training mid-shard; Trainer.close() must reap the
+    loader's producer thread."""
+    cfg = Config(
+        model="lr",
+        train_path=toy_dataset.train_prefix,
+        batch_size=16,
+        table_size_log2=14,
+        max_nnz=24,
+        num_devices=1,
+        prefetch_batches=2,
+        epochs=1,
+    )
+    t = Trainer(cfg)
+    it = t.iter_train_batches()
+    next(it)  # the shard prefetch is now live
+    live = list(t._live_prefetch)
+    assert live and any(p.alive for p in live)
+    t.close()
+    for p in live:
+        assert _wait_dead(p)

@@ -294,6 +294,39 @@ class Config:
     # "auto" (default): compact whenever valid, else full.
     wire_mode: str = "auto"  # {"auto", "full", "compact"}
 
+    # Host-side batch compaction + dictionary wire (io/compact.py):
+    # deduplicate each batch's cold keys on the host, ship a per-batch
+    # dictionary of the most-duplicated keys (u16 occurrence indices,
+    # consumed directly by the device's consolidation — no device
+    # argsort) plus the near-unique tail as raw u24/u32, tiered hot
+    # ids, flattened padding-free planes, and bitmap labels/weights —
+    # measured ~70 wire bytes/example vs 130 for the plain compact
+    # wire at the bench flagship (docs/PERF.md "Wire format and
+    # compaction").  "auto" (default): on whenever eligible — hash
+    # mode, single process + single-device mesh (the dictionary/stream
+    # planes have no batch-axis sharding), max_nnz/hot_nnz <= 255, hot
+    # table absent or hot_size_log2 <= 16, and the wire_mode compact
+    # eligibility.  "on" raises when ineligible; "off" keeps the plain
+    # compact/full wire.
+    wire_dedup: str = "auto"  # {"auto", "off", "on"}
+
+    # Hot-path gather/scatter implementation (ops/hot.py): "mxu" = the
+    # two-level one-hot matmul path (the TPU win — ~2-4x over per-slice
+    # DMA on v5e); "seg" = plain gather + segment-sum (the CPU-fast
+    # form: one-hot matmuls are an MXU trick, measured 3.3x slower
+    # than the gather on the CPU backend).  "auto" picks "mxu" on TPU
+    # meshes and "seg" elsewhere.  Numerics: gather is exact either
+    # way; scatter differs only in summation order.
+    hot_impl: str = "auto"  # {"auto", "mxu", "seg"}
+
+    # Device staging ring depth: how many batches ahead the host->device
+    # transfer (put_batch — compaction + h2d) runs on worker threads,
+    # overlapping link round-trips and compaction with device compute
+    # (trainer._transfer_ahead; single-host only — multi-host transfers
+    # are collective).  >= 2 keeps the link busy while a transfer is in
+    # flight (double buffering); raise it on high-latency links.
+    transfer_ahead: int = 2
+
     def __post_init__(self) -> None:
         if self.model not in ("lr", "fm", "mvm", "ffm", "wide_deep"):
             raise ValueError(f"unknown model {self.model!r}")
@@ -350,6 +383,12 @@ class Config:
             raise ValueError(f"unknown pred_style {self.pred_style!r}")
         if self.wire_mode not in ("auto", "full", "compact"):
             raise ValueError(f"unknown wire_mode {self.wire_mode!r}")
+        if self.wire_dedup not in ("auto", "off", "on"):
+            raise ValueError(f"unknown wire_dedup {self.wire_dedup!r}")
+        if self.hot_impl not in ("auto", "mxu", "seg"):
+            raise ValueError(f"unknown hot_impl {self.hot_impl!r}")
+        if self.transfer_ahead < 1:
+            raise ValueError("transfer_ahead must be >= 1")
         if self.obs_trace_capacity < 1:
             raise ValueError("obs_trace_capacity must be >= 1")
         if self.obs_flight_events < 1:

@@ -2,6 +2,7 @@ from xflow_tpu.io.hashing import murmur64, murmur64_batch
 from xflow_tpu.io.libffm import parse_block, BlockReader
 from xflow_tpu.io.loader import ShardLoader, shard_path
 from xflow_tpu.io.batch import Batch
+from xflow_tpu.io.compact import CompactBatch, compact_batch
 
 __all__ = [
     "murmur64",
@@ -11,4 +12,6 @@ __all__ = [
     "ShardLoader",
     "shard_path",
     "Batch",
+    "CompactBatch",
+    "compact_batch",
 ]

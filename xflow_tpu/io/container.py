@@ -23,9 +23,11 @@ def sniff(path: str, magic: bytes) -> bool:
 
 
 def read_header(
-    f: BinaryIO, magic: bytes, what: str, version: int = 1
+    f: BinaryIO, magic: bytes, what: str, version: int | tuple = 1
 ) -> tuple[dict, int]:
-    """Returns (header dict, byte offset of the first record)."""
+    """Returns (header dict, byte offset of the first record).
+    ``version`` may be a tuple when a format spans several on-disk
+    versions the caller knows how to read (io/packed.py v1/v2)."""
     got = f.read(len(magic))
     if got != magic:
         raise ValueError(f"not a {what} (bad magic)")
@@ -37,10 +39,11 @@ def read_header(
     if len(body) != hlen:
         raise ValueError(f"truncated {what} header")
     meta = json.loads(body)
-    if meta.get("version") != version:
+    versions = version if isinstance(version, tuple) else (version,)
+    if meta.get("version") not in versions:
         raise ValueError(
             f"unsupported {what} version {meta.get('version')!r} "
-            f"(expected {version})"
+            f"(expected {' or '.join(map(str, versions))})"
         )
     return meta, len(magic) + _HLEN.size + hlen
 

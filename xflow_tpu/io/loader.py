@@ -95,6 +95,7 @@ class ShardLoader:
         hot_size: int = 0,
         hot_nnz: int = 0,
         obs=None,  # obs.Obs: parse/pack phase seconds + byte counters
+        emit_compact: bool = False,  # v2 packed shards: yield CompactBatch
     ):
         self.path = path
         self.batch_size = batch_size
@@ -111,6 +112,11 @@ class ShardLoader:
         self.remap = remap
         self.hot_size = hot_size
         self.hot_nnz = hot_nnz
+        # With emit_compact, v2 packed shards (io/packed.py) yield
+        # their records AS CompactBatch — the consumer (a dict-wire
+        # TrainStep via put_batch) then pays ZERO per-batch host work;
+        # other formats still yield padded Batches.
+        self.emit_compact = emit_compact
         # Parse/pack run on worker threads under prefetch/parse_workers,
         # so their phase seconds OVERLAP the consumer's wall-clock — the
         # trainer reports them in the epoch record's "overlapped" dict,
@@ -266,7 +272,11 @@ class ShardLoader:
             remap=self.remap,
         )
         flight = self.obs.flight
-        for batch, _, next_offset in packed.iter_batches(f, start_offset):
+        if self.emit_compact and meta.get("version", 1) == 2:
+            records = packed.iter_compact_batches(f, start_offset)
+        else:
+            records = packed.iter_batches(f, start_offset)
+        for batch, _, next_offset in records:
             if flight is not None:
                 flight.note_loader("packed_batch")
             yield batch, next_offset
@@ -336,43 +346,94 @@ class ShardLoader:
 _SENTINEL = object()
 
 
-def _prefetch_iter(it: Iterator, depth: int) -> Iterator:
-    """Run ``it`` on a daemon thread, buffering up to ``depth`` items.
-    Exceptions propagate to the consumer; the thread stops early if the
-    consumer abandons the iterator (queue slot freed on GC via timeout)."""
-    if depth <= 0:
-        yield from it
-        return
-    q: queue.Queue = queue.Queue(maxsize=depth)
-    stop = threading.Event()
+class _PrefetchIter:
+    """``it`` running on a daemon producer thread, buffering up to
+    ``depth`` items.  Exceptions propagate to the consumer.
 
-    def put_or_abort(item) -> bool:
-        while not stop.is_set():
+    The round-4 design relied on a queue-put timeout plus GC to stop
+    the producer when a consumer abandoned the iterator — which LEAKS
+    the thread (and its open shard file) until the garbage collector
+    happens to run the generator's finally block.  This object makes
+    shutdown explicit: ``close()`` signals the producer, drains the
+    queue so a blocked put wakes immediately, and joins the thread.
+    Trainer.close() closes every live prefetch it spawned; use the
+    iterator as a context manager elsewhere.  ``depth <= 0`` degrades
+    to a synchronous passthrough with the same close() surface."""
+
+    def __init__(self, it: Iterator, depth: int):
+        self._source = it
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        if depth <= 0:
+            return
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _put_or_abort(self, item) -> bool:
+        while not self._stop.is_set():
             try:
-                q.put(item, timeout=0.1)
+                self._q.put(item, timeout=0.1)
                 return True
             except queue.Full:
                 continue
         return False
 
-    def producer():
+    def _produce(self) -> None:
         try:
-            for item in it:
-                if not put_or_abort(item):
+            for item in self._source:
+                if not self._put_or_abort(item):
                     return
-            put_or_abort(_SENTINEL)
+            self._put_or_abort(_SENTINEL)
         except BaseException as e:  # propagate to consumer
-            put_or_abort(e)
+            self._put_or_abort(e)
 
-    t = threading.Thread(target=producer, daemon=True)
-    t.start()
-    try:
-        while True:
-            item = q.get()
-            if item is _SENTINEL:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-    finally:
-        stop.set()
+    def __iter__(self) -> "_PrefetchIter":
+        return self
+
+    def __next__(self):
+        if self._thread is None:  # synchronous passthrough
+            if self._closed:
+                raise StopIteration
+            return next(self._source)
+        if self._closed:
+            raise StopIteration
+        item = self._q.get()
+        if item is _SENTINEL:
+            self._closed = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._closed = True
+            raise item
+        return item
+
+    def close(self, join_timeout: float = 5.0) -> None:
+        """Stop the producer thread and release its resources.
+        Idempotent; safe from any thread."""
+        self._closed = True
+        if self._thread is None:
+            return
+        self._stop.set()
+        # drain so a producer blocked on a full queue observes the
+        # stop event on its next timeout tick at the latest
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=join_timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def __enter__(self) -> "_PrefetchIter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _prefetch_iter(it: Iterator, depth: int) -> _PrefetchIter:
+    return _PrefetchIter(it, depth)

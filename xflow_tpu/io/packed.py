@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import struct
 from typing import BinaryIO, Iterator
 
 import numpy as np
@@ -52,6 +53,18 @@ from xflow_tpu.io import container
 from xflow_tpu.io.batch import Batch
 
 MAGIC = b"XFPB0001"
+
+# v2 (format version in the JSON header; MIGRATION.md "Packed cache
+# v2"): records hold CompactBatch planes (io/compact.py) instead of the
+# padded [B, K] arrays — ~7x smaller on disk at the flagship geometry,
+# and the steady-state reader hands the trainer PRE-COMPACTED batches,
+# so epochs 2..N pay zero per-batch compaction or wire-packing work.
+# Records are variable-size (content-sized planes under plane_cap
+# bucketing), each prefixed by a fixed binary counts header; resume
+# offsets are validated by walking the record chain (a packed shard
+# holds ~examples/B records — double digits — so the walk is free).
+_REC_HEADER = struct.Struct("<8q")  # n_real n_cold n_dict n_dict_occ
+#                                     n_hot n_h8 slots_code rec_bytes
 
 
 def remap_digest(remap: np.ndarray | None) -> str | None:
@@ -67,7 +80,7 @@ def is_packed_shard(path: str) -> bool:
 
 
 def read_header(f: BinaryIO) -> tuple[dict, int]:
-    return container.read_header(f, MAGIC, "packed shard")
+    return container.read_header(f, MAGIC, "packed shard", version=(1, 2))
 
 
 def _layout(meta: dict) -> tuple[list[tuple[str, tuple, np.dtype]], int]:
@@ -165,6 +178,184 @@ def write_shard(
     return header
 
 
+def write_shard_v2(
+    dst: str, meta: dict, batches: Iterator[Batch]
+) -> dict:
+    """Stream ``batches`` through host compaction (io/compact.py) into
+    a v2 packed shard of CompactBatch records (atomic temp + rename).
+    ``meta`` must hold the config keys of check_compat; wire parameters
+    and totals are filled in here."""
+    from xflow_tpu.io import compact as C
+
+    tmp = f"{dst}.tmp.{os.getpid()}"
+    os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
+    key_bytes = 3 if meta["table_size"] <= 1 << 24 else 4
+    hx16 = meta["hot_size"] > 1 << 12
+    header = {
+        "version": 2,
+        **meta,
+        "dict_cap": C.DICT_CAP,
+        "granule_div": C.GRANULE_DIV,
+        "granule_min": C.GRANULE_MIN,
+        "key_bytes": key_bytes,
+        "hx16": hx16,
+    }
+    n_batches = 0
+    examples = 0
+    try:
+        with open(tmp, "wb") as f:
+            hdr_len = container.write_placeholder_header(
+                f, MAGIC, header, ("batches", "examples")
+            )
+            for batch in batches:
+                cb = C.CompactBatch.from_batch(
+                    batch,
+                    meta["table_size"],
+                    meta["hot_size"],
+                    check=n_batches == 0,
+                    strict_layout=True,
+                )
+                specs = C.plane_specs(
+                    batch_size=cb.batch_size,
+                    cold_nnz=cb.cold_nnz,
+                    hot_nnz_cap=cb.hot_nnz_cap,
+                    key_bytes=cb.key_bytes,
+                    hx16=cb.hx16,
+                    slots_code=cb.slots_code,
+                    n_cold=cb.n_cold,
+                    n_dict=cb.n_dict,
+                    n_dict_occ=cb.n_dict_occ,
+                    n_hot=cb.n_hot,
+                    n_h8=cb.n_h8,
+                )
+                if cb.key_bytes != key_bytes or cb.hx16 != hx16:
+                    raise ValueError(
+                        "compact batch wire parameters drifted from "
+                        "the shard header — geometry mismatch?"
+                    )
+                blobs = []
+                for name, shape, dtype in specs:
+                    arr = getattr(cb, name)
+                    if arr.shape != shape or arr.dtype != dtype:
+                        raise ValueError(
+                            f"record plane {name}: {arr.shape}/"
+                            f"{arr.dtype} != spec {shape}/{dtype}"
+                        )
+                    blobs.append(np.ascontiguousarray(arr).tobytes())
+                body = b"".join(blobs)
+                f.write(_REC_HEADER.pack(
+                    cb.n_real, cb.n_cold, cb.n_dict, cb.n_dict_occ,
+                    cb.n_hot, cb.n_h8, cb.slots_code,
+                    _REC_HEADER.size + len(body),
+                ))
+                f.write(body)
+                n_batches += 1
+                examples += cb.n_real
+            header.update({"batches": n_batches, "examples": examples})
+            container.rewrite_header(f, MAGIC, header, hdr_len)
+        os.replace(tmp, dst)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    header.pop("version")
+    return header
+
+
+def _iter_records_v2(f: BinaryIO, meta: dict, start_offset: int):
+    """Yield (CompactBatch, offset, next_offset) over a v2 shard.
+    Record planes are read-only zero-copy views of the mmap; the mmap
+    outlives ``f`` (numpy views hold it via .base)."""
+    import mmap
+
+    from xflow_tpu.io import compact as C
+
+    f.seek(0)
+    _, data_start = read_header(f)
+    try:
+        mm: memoryview | bytes | mmap.mmap = mmap.mmap(
+            f.fileno(), 0, access=mmap.ACCESS_READ
+        )
+        if hasattr(mmap, "MADV_SEQUENTIAL"):
+            mm.madvise(mmap.MADV_SEQUENTIAL)
+    except (ValueError, OSError):
+        f.seek(0)
+        mm = f.read()  # unmmapable stream: buffer it
+    end = len(mm)
+    offset = data_start
+    start_offset = max(int(start_offset), data_start)
+    if start_offset > end:
+        raise ValueError(
+            f"resume offset {start_offset} is past the packed shard "
+            f"end {end} — was the cache rebuilt since the checkpoint?"
+        )
+    boundary_ok = start_offset == data_start
+    while offset < end:
+        if offset + _REC_HEADER.size > end:
+            raise ValueError("truncated packed shard record")
+        (
+            n_real, n_cold, n_dict, n_dict_occ, n_hot, n_h8,
+            slots_code, rec_bytes,
+        ) = _REC_HEADER.unpack_from(mm, offset)
+        if rec_bytes <= 0 or offset + rec_bytes > end:
+            raise ValueError("truncated packed shard record")
+        next_offset = offset + rec_bytes
+        if offset == start_offset:
+            boundary_ok = True
+        if offset >= start_offset:
+            if not boundary_ok:
+                raise ValueError(
+                    f"start_offset {start_offset} is not a record "
+                    "boundary"
+                )
+            counts = {
+                "n_real": n_real, "n_cold": n_cold, "n_dict": n_dict,
+                "n_dict_occ": n_dict_occ, "n_hot": n_hot,
+                "n_h8": n_h8, "slots_code": slots_code,
+            }
+            specs = C.plane_specs(
+                batch_size=meta["batch_size"],
+                cold_nnz=meta["cold_nnz"],
+                hot_nnz_cap=meta["hot_nnz"],
+                key_bytes=meta["key_bytes"],
+                hx16=meta["hx16"],
+                slots_code=slots_code,
+                dict_cap=meta["dict_cap"],
+                granule_div=meta["granule_div"],
+                granule_min=meta["granule_min"],
+                **{k: counts[k] for k in (
+                    "n_cold", "n_dict", "n_dict_occ", "n_hot", "n_h8"
+                )},
+            )
+            pos = offset + _REC_HEADER.size
+            planes = {}
+            for name, shape, dtype in specs:
+                count = int(np.prod(shape))
+                planes[name] = np.frombuffer(
+                    mm, dtype, count=count, offset=pos
+                ).reshape(shape)
+                pos += count * dtype.itemsize
+            if pos > next_offset:
+                raise ValueError("packed shard record size mismatch")
+            yield C.from_planes(meta, counts, planes), offset, next_offset
+        offset = next_offset
+    if not boundary_ok and start_offset != offset:
+        raise ValueError(
+            f"start_offset {start_offset} is not a record boundary"
+        )
+
+
+def iter_compact_batches(
+    f: BinaryIO, start_offset: int = 0
+):
+    """Yield (CompactBatch, offset, next_offset) from a v2 shard (raises
+    on v1 — those records hold padded arrays, not compact planes)."""
+    f.seek(0)
+    meta, _ = read_header(f)
+    if meta.get("version", 1) != 2:
+        raise ValueError("iter_compact_batches requires a v2 packed shard")
+    yield from _iter_records_v2(f, meta, start_offset)
+
+
 def iter_batches(
     f: BinaryIO, start_offset: int = 0
 ) -> Iterator[tuple[Batch, int, int]]:
@@ -177,11 +368,21 @@ def iter_batches(
     half the record) never pages the rest in, which roughly doubles the
     measured host feed rate over the old read()-a-record path.  The
     mmap outlives ``f`` (numpy views hold it via .base), so batches may
-    be used after the file is closed."""
+    be used after the file is closed.
+
+    v2 shards hold CompactBatch records; this interface expands them
+    to padded Batches (byte-exact — io/compact.py) so every consumer
+    of the v1 contract keeps working.  Consumers that can feed the
+    dict wire directly use ``iter_compact_batches`` and skip both the
+    expansion and the re-compaction (ShardLoader emit_compact)."""
     import mmap
 
     f.seek(0)
     meta, data_start = read_header(f)
+    if meta.get("version", 1) == 2:
+        for cb, off, noff in _iter_records_v2(f, meta, start_offset):
+            yield cb.expand(), off, noff
+        return
     fields, rec_size = _layout(meta)
     offset = max(int(start_offset), data_start)
     if (offset - data_start) % rec_size:
@@ -254,9 +455,13 @@ def convert_shard(
     block_mib: float = 8,
     remap: np.ndarray | None = None,
     parse_fn=None,
+    fmt: str = "auto",
 ) -> dict:
     """Pack one shard (text or CSR-binary — ShardLoader sniffs) into
-    device-ready batches."""
+    device-ready batches.  ``fmt``: "v1" = padded-array records, "v2" =
+    compacted records (io/compact.py — smaller and pre-compacted for
+    the dict wire), "auto" = v2 whenever the compaction invariants hold
+    (hash mode; u8 per-row counts; hot ids fit the tiered encoding)."""
     from xflow_tpu.io.loader import ShardLoader
 
     loader = ShardLoader(
@@ -283,7 +488,22 @@ def convert_shard(
         "hash_seed": int(hash_seed),
         "remap_sha256": remap_digest(remap),
     }
-    return write_shard(
+    if fmt not in ("auto", "v1", "v2"):
+        raise ValueError(f"unknown packed format {fmt!r}")
+    v2_ok = (
+        bool(hash_mode)
+        and max_nnz <= 255
+        and (hot_nnz if hot_size else 0) <= 255
+        and (not hot_size or hot_size <= 1 << 16)
+    )
+    if fmt == "v2" and not v2_ok:
+        raise ValueError(
+            "packed v2 requires hash_mode, max_nnz/hot_nnz <= 255 "
+            "and hot_size <= 2^16"
+        )
+    writer = write_shard_v2 if (fmt == "v2" or (fmt == "auto" and v2_ok)) \
+        else write_shard
+    return writer(
         dst, meta, (b for b, _ in loader.iter_batches())
     )
 
@@ -309,6 +529,11 @@ def main(argv=None) -> int:
     p.add_argument("--no-hash", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--block-mib", type=float, default=8)
+    p.add_argument(
+        "--format", choices=("auto", "v1", "v2"), default="auto",
+        help="record format: v2 = compacted records (default when "
+        "eligible; docs/MIGRATION.md)",
+    )
     a = p.parse_args(argv)
     remap = freq.load_remap(a.remap) if a.remap else None
     if a.hot_size_log2 and remap is None:
@@ -327,6 +552,7 @@ def main(argv=None) -> int:
             hash_seed=a.seed,
             block_mib=a.block_mib,
             remap=remap,
+            fmt=a.format,
         )
         print(
             f"{src} -> {dst}: {meta['examples']} examples in "

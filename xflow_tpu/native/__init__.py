@@ -1,6 +1,8 @@
 from xflow_tpu.native.ffi import (
     available,
+    has_dict_encode,
     load_library,
+    native_dict_encode,
     native_murmur64,
     native_pack_batch,
     native_parse_block,
@@ -8,7 +10,9 @@ from xflow_tpu.native.ffi import (
 
 __all__ = [
     "available",
+    "has_dict_encode",
     "load_library",
+    "native_dict_encode",
     "native_murmur64",
     "native_pack_batch",
     "native_parse_block",

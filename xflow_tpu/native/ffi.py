@@ -20,6 +20,7 @@ from xflow_tpu.io.batch import ParsedBlock
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _load_failed = False
+_has_dict_encode = False
 
 
 def load_library() -> ctypes.CDLL | None:
@@ -69,6 +70,22 @@ def _bind(lib: ctypes.CDLL) -> None:
     f32p = ctypes.POINTER(ctypes.c_float)
     i32p = ctypes.POINTER(ctypes.c_int32)
     i64p = ctypes.POINTER(ctypes.c_int64)
+    # Optional (added after the first shipped .so): a cached library
+    # missing it must still serve the parser/pack fast paths, so bind
+    # it best-effort instead of letting a missing symbol fail _bind.
+    global _has_dict_encode
+    try:
+        lib.xf_dict_encode.restype = ctypes.c_int64
+        lib.xf_dict_encode.argtypes = [
+            i64p,  # keys
+            ctypes.c_int64,  # n
+            ctypes.c_int64,  # dict_cap
+            i64p,  # uniq_out
+            ctypes.POINTER(ctypes.c_uint32),  # code_out
+        ]
+        _has_dict_encode = True
+    except AttributeError:
+        _has_dict_encode = False
     lib.xf_pack_batch.restype = ctypes.c_int64
     lib.xf_pack_batch.argtypes = [
         i64p,  # row_ptr
@@ -92,6 +109,34 @@ def _bind(lib: ctypes.CDLL) -> None:
 
 def available() -> bool:
     return load_library() is not None
+
+
+def has_dict_encode() -> bool:
+    return load_library() is not None and _has_dict_encode
+
+
+def native_dict_encode(
+    keys: np.ndarray, dict_cap: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Drop-in replacement for io.compact.dedup_select's numpy path
+    (same selected SET by construction; dictionary order differs —
+    parity enforced by tests/test_compact.py)."""
+    lib = load_library()
+    assert lib is not None and _has_dict_encode, "xf_dict_encode unavailable"
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    n = len(keys)
+    uniq = np.empty(dict_cap, np.int64)
+    codes = np.empty(n, np.uint32)
+    nd = lib.xf_dict_encode(
+        _ptr(keys, ctypes.c_int64),
+        n,
+        dict_cap,
+        _ptr(uniq, ctypes.c_int64),
+        _ptr(codes, ctypes.c_uint32),
+    )
+    if nd < 0:
+        raise MemoryError("xf_dict_encode: allocation failed")
+    return uniq[:nd].copy(), codes
 
 
 def native_murmur64(data: bytes, seed: int = 0) -> int:

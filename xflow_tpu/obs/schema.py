@@ -91,6 +91,21 @@ SCHEMA: dict[str, dict[str, Any]] = {
         "epoch": int,
         "devices": list,
     },
+    # one per training epoch when any batch crossed the host->device
+    # link: wire-format accounting (parallel/step.py::_book_wire;
+    # docs/PERF.md "Wire format and compaction").  format names the
+    # wire that ran ("dict" = host-compacted dictionary wire, "compact",
+    # "full"); wire_bytes_per_example is what actually crossed the link
+    # per real example; compaction_ratio is cold occurrences per
+    # big-table touch after host dedup (1.0 = no dedup)
+    "wire": {
+        "t": (int, float),
+        "kind": str,
+        "epoch": int,
+        "format": str,
+        "wire_bytes_per_example": (int, float),
+        "compaction_ratio": (int, float),
+    },
     # -- serving (serve/; docs/SERVING.md) ---------------------------------
     # one per PredictEngine artifact load: bucket geometry + warmup cost
     "serve_load": {
@@ -178,6 +193,12 @@ OPTIONAL: dict[str, dict[str, Any]] = {
     "run_start": {
         "hostname": str,
         "pid": int,
+    },
+    "train_epoch": {
+        # single-host runs under trainer._transfer_ahead only
+        "transfer_ahead_depth_mean": (int, float),
+        # loaders that report parse phase bytes only
+        "parse_mb_per_sec": (int, float),
     },
 }
 

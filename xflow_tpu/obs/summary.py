@@ -154,6 +154,16 @@ def format_run(run: Run) -> str:
             f"auc={ev.get('auc', float('nan')):.6f} "
             f"examples={ev.get('examples', 0)}"
         )
+    wire = run.kind("wire")
+    if wire:
+        last = wire[-1]
+        out.append(
+            f"wire: format={last.get('format', '?')} "
+            f"{last.get('wire_bytes_per_example', 0.0):.1f} B/example, "
+            f"compaction {last.get('compaction_ratio', 1.0):.2f}x "
+            "(cold occurrences per table touch; docs/PERF.md "
+            "\"Wire format and compaction\")"
+        )
     shards = run.shards
     if shards:
         rates = [s.get("examples_per_sec", 0.0) for s in shards]

@@ -73,6 +73,7 @@ def hot_gather(
     keys: jax.Array,
     *,
     dtype=jnp.float32,
+    impl: str = "mxu",
 ) -> jax.Array:
     """Gather rows of the hot table via two-level one-hot matmuls.
 
@@ -80,10 +81,21 @@ def hot_gather(
       w_hot: [H, D] hot-table rows (H a power of two).
       keys: int32 [M]; entries outside [0, H) yield zero rows.
       dtype: matmul input dtype (float32 exact, bfloat16 fast).
+      impl: "mxu" — the one-hot matmul path (the TPU win this module
+        exists for); "seg" — a plain clip-gather with zero fill.  Same
+        contract, exact in float32 either way; "seg" is the CPU-fast
+        form (one-hot matmuls are an MXU trick — measured 3.3x slower
+        than the gather on the CPU backend, docs/PERF.md "Wire format
+        and compaction") and ignores ``dtype`` (always exact).
+        TrainStep picks per platform via Config.hot_impl.
 
     Returns: [M, D] gathered rows, float32.
     """
     h, d = w_hot.shape
+    if impl == "seg":
+        rows = w_hot[jnp.clip(keys, 0, h - 1)]
+        ok = (keys >= 0) & (keys < h)
+        return jnp.where(ok[:, None], rows, 0.0).astype(jnp.float32)
     h1, h2 = hot_factors(h)
     m = keys.shape[0]
     c = _chunk(h1, h2, d, m)
@@ -113,6 +125,7 @@ def hot_scatter(
     hot_size: int,
     *,
     dtype=jnp.float32,
+    impl: str = "mxu",
 ) -> jax.Array:
     """Sum per-occurrence gradients into a dense [H, D] buffer via
     two-level one-hot matmuls (the MXU replacement for
@@ -123,10 +136,20 @@ def hot_scatter(
       grads: float [M, D].
       hot_size: H (power of two).
       dtype: matmul input dtype for the [h1, M]@[M, h2*D] contraction.
+      impl: "mxu" (one-hot matmuls) or "seg" (segment-sum into the
+        [H, D] buffer — the CPU-fast form; same sums, summation order
+        differs like the MXU path differs from ``.at[].add``).
 
     Returns: [H, D] float32 gradient sums.
     """
     m, d = grads.shape
+    if impl == "seg":
+        seg = jnp.where(
+            (keys >= 0) & (keys < hot_size), keys, jnp.int32(hot_size)
+        )
+        return jax.ops.segment_sum(
+            grads.astype(jnp.float32), seg, num_segments=hot_size + 1
+        )[:hot_size]
     h1, h2 = hot_factors(hot_size)
     c = _chunk(h1, h2, d, m)
     m_pad = ((m + c - 1) // c) * c

@@ -99,6 +99,25 @@ def consolidate_apply(
     return jax.ops.segment_sum(sg, seg, num_segments=order.shape[0])
 
 
+def consolidate_indexed(
+    grads: jax.Array, uidx: jax.Array, num_slots: int
+) -> jax.Array:
+    """Consolidation with the plan computed on the HOST: sum [M, D]
+    per-occurrence gradients into ``num_slots`` unique-key slots via a
+    precomputed u32 index (io/compact.py's dictionary codes, shipped
+    on the wire).  Entries carrying ``uidx == num_slots`` (padding /
+    tail-tier occurrences) are dropped.
+
+    This is ``consolidate_plan`` + ``consolidate_apply`` minus the
+    device argsort — the dedup moved to the host, where it is free
+    relative to the link (docs/PERF.md "Wire format and compaction").
+    Slot i pairs with the wire's dictionary key i.
+    """
+    return jax.ops.segment_sum(
+        grads, uidx, num_segments=num_slots + 1
+    )[:num_slots]
+
+
 def gather_rows(table: jax.Array, ukeys: jax.Array) -> jax.Array:
     """Gather [U, D] state rows; sentinel keys clamp to the last row
     (their updates are dropped on scatter, see module docstring)."""

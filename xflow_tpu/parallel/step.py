@@ -30,6 +30,7 @@ from xflow_tpu.models.base import BatchArrays, Model
 from xflow_tpu.obs import NULL_OBS
 from xflow_tpu.ops.sparse import (
     consolidate_apply,
+    consolidate_indexed,
     consolidate_plan,
     gather_rows,
     scatter_rows,
@@ -173,6 +174,12 @@ def compact_wire_np(
     return out
 
 
+def _checked(batch: Batch, check: bool) -> Batch:
+    if check:
+        validate_compact_batch(batch)
+    return batch
+
+
 def batch_to_compact(
     batch: Batch,
     check: bool = True,
@@ -256,6 +263,42 @@ class TrainStep:
             )
         self.compact_wire = cfg.wire_mode != "full" and compact_ok
         self._compact_validated = False
+        # Hot-path implementation (ops/hot.py): one-hot MXU matmuls on
+        # TPU, gather + segment-sum elsewhere (Config.hot_impl) — the
+        # MXU trick measured 3.3x SLOWER than the gather on the CPU
+        # backend (docs/PERF.md "Wire format and compaction").
+        platform = str(self.mesh.devices.ravel()[0].platform)
+        self._hot_impl = (
+            cfg.hot_impl
+            if cfg.hot_impl != "auto"
+            else ("mxu" if platform == "tpu" else "seg")
+        )
+        # Dictionary-wire eligibility (Config.wire_dedup; io/compact.py):
+        # host-side batch compaction needs the compact-wire invariants
+        # PLUS single process + single-device mesh (the dictionary and
+        # flat occurrence streams have no batch-axis sharding), u8
+        # per-row counts, and hot ids that fit the tiered encoding.
+        kh = cfg.hot_nnz if cfg.hot_size else 0
+        dict_ok = (
+            compact_ok
+            and jax.process_count() == 1
+            and self.mesh.devices.size == 1
+            and cfg.max_nnz <= 255
+            and kh <= 255
+            and (not cfg.hot_size_log2 or cfg.hot_size_log2 <= 16)
+        )
+        if cfg.wire_dedup == "on" and not dict_ok:
+            raise ValueError(
+                "wire_dedup='on' requires the compact-wire invariants "
+                "(hash_mode; max_fields <= 255 for slot models), a "
+                "single-process single-device mesh, max_nnz/hot_nnz "
+                "<= 255, and hot_size_log2 <= 16"
+            )
+        self.dict_wire = (
+            cfg.wire_mode != "full"
+            and cfg.wire_dedup != "off"
+            and dict_ok
+        )
         # Observability hook (obs/__init__.py): the trainer swaps in a
         # live Obs; the default NULL_OBS makes every span a shared no-op
         # object, so direct users (bench.py run()) pay nothing.
@@ -265,25 +308,95 @@ class TrainStep:
 
     # -- helpers -----------------------------------------------------------
 
-    def put_batch(self, batch: Batch) -> BatchArrays:
-        """Host->device transfer, booked as the 'h2d' phase.  Under
-        trainer._transfer_ahead this runs on a worker thread and the
-        seconds land in the epoch record's overlapped dict; called
-        inline (multi-host, eval) they are main-thread-exclusive."""
+    def put_batch(self, batch) -> BatchArrays:
+        """Host->device transfer, booked as the 'h2d' phase; accepts a
+        Batch or a pre-compacted CompactBatch (packed-cache v2
+        records).  Under trainer._transfer_ahead this runs on a worker
+        thread and the seconds land in the epoch record's overlapped
+        dict; called inline (multi-host, eval) they are
+        main-thread-exclusive."""
         with self.obs.phase("h2d"):
             return self._put_batch_impl(batch)
 
-    def _put_batch_impl(self, batch: Batch) -> BatchArrays:
+    @property
+    def wire_format(self) -> str:
+        return (
+            "dict" if self.dict_wire
+            else "compact" if self.compact_wire
+            else "full"
+        )
+
+    def _book_wire(self, nbytes: int, examples: int, cb=None) -> None:
+        """Wire accounting counters behind the trainer's per-epoch
+        ``wire`` metrics row (obs/schema.py): bytes that crossed the
+        link, examples they carried, and — dict wire — the cold
+        occurrence/unique-touch compaction the host performed."""
+        self.obs.counter("wire.bytes", nbytes)
+        self.obs.counter("wire.examples", examples)
+        self.obs.counter("wire.batches")
+        if cb is not None:
+            self.obs.counter("wire.cold_occ", cb.n_cold)
+            self.obs.counter("wire.cold_touched", cb.cold_touched)
+
+    def _dict_geometry_ok(self, batch) -> bool:
+        """A batch rides the dict wire only at the loader geometry the
+        decode is traced for; other widths (external predict batches)
+        keep the plain wire."""
+        cfg = self.cfg
+        kh = cfg.hot_nnz if cfg.hot_size else 0
+        return batch.max_nnz == cfg.max_nnz and batch.hot_nnz == kh
+
+    def host_wire_np(self, batch, check: bool = False):
+        """The host half of put_batch: the numpy planes that cross the
+        link for ``batch`` under this step's wire format, plus the
+        CompactBatch when the dict wire ran (None otherwise).  Shared
+        with bench.py's host-feed measurement so the measured per-batch
+        work is by construction exactly the training feed's."""
+        from xflow_tpu.io.compact import CompactBatch
+
+        if isinstance(batch, CompactBatch):
+            # pre-compacted (packed-cache v2 records): plane collection
+            # only — zero per-batch host work
+            if self.dict_wire and self._dict_geometry_ok(batch):
+                return batch.wire(self._ship_slots), batch
+            batch = batch.expand()
+        if self.dict_wire and self._dict_geometry_ok(batch):
+            cb = CompactBatch.from_batch(
+                batch, self.cfg.table_size, self.cfg.hot_size,
+                check=check,
+            )
+            return cb.wire(self._ship_slots), cb
         if self.compact_wire:
-            arrays = batch_to_compact(
-                batch,
-                check=not self._compact_validated,
+            return compact_wire_np(
+                _checked(batch, check),
                 ship_slots=self._ship_slots,
                 hot_u16=self._hot_u16,
-            )
-            self._compact_validated = True
-        else:
-            arrays = batch_to_arrays(batch)
+            ), None
+        wire = {
+            "keys": batch.keys, "slots": batch.slots,
+            "vals": batch.vals, "mask": batch.mask,
+            "labels": batch.labels, "weights": batch.weights,
+        }
+        if batch.hot_nnz:
+            wire.update({
+                "hot_keys": batch.hot_keys,
+                "hot_slots": batch.hot_slots,
+                "hot_vals": batch.hot_vals,
+                "hot_mask": batch.hot_mask,
+            })
+        return wire, None
+
+    def _put_batch_impl(self, batch) -> BatchArrays:
+        wire, cb = self.host_wire_np(
+            batch, check=not self._compact_validated
+        )
+        self._compact_validated = True
+        self._book_wire(
+            sum(int(v.nbytes) for v in wire.values()),
+            batch.num_real(),
+            cb=cb,
+        )
+        arrays = {k: jnp.asarray(v) for k, v in wire.items()}
         if jax.process_count() > 1:
             # Each host loaded its own shard subset (trainer._my_shards);
             # assemble a global array from per-process local batches.
@@ -310,11 +423,162 @@ class TrainStep:
         with self.obs.phase("dispatch"):
             return self.train(state, arrays)
 
+    def _expand_dict_wire(self, w: BatchArrays) -> BatchArrays:
+        """Inverse of CompactBatch.wire (io/compact.py), inside the
+        jitted step: rebuild the padded [B, K] planes from the flat
+        tiered streams, and keep the host-computed dictionary indices
+        as ``cold_uidx``/``cold_dict_keys``/``cold_tail_keys`` so
+        _scatter_grads can consolidate WITHOUT a device argsort.
+
+        Every plane capacity is static (plane_cap bucketing), so one
+        steady batch geometry is one compiled program; the per-batch
+        real counts arrive as the cc/hc count planes and the cw_cun
+        scalar."""
+        cfg = self.cfg
+        kc = cfg.max_nnz
+        b = w["cw_cc"].shape[0]
+        t_sent = jnp.int32(cfg.table_size)
+
+        def bits(plane: jax.Array, n: int) -> jax.Array:
+            i = jnp.arange(n, dtype=jnp.int32)
+            return (
+                plane[i >> 3].astype(jnp.int32) >> (i & 7)
+            ) & 1
+
+        def keys_plane(plane: jax.Array) -> jax.Array:
+            if plane.ndim == 1:  # u32
+                return plane.astype(jnp.int32)
+            p = plane.astype(jnp.int32)  # [n, 3] u24 little-endian
+            return p[:, 0] | (p[:, 1] << 8) | (p[:, 2] << 16)
+
+        def tiered(
+            counts, flags_plane, a_plane, b_vals, width
+        ):
+            """Rebuild a [B, width] id plane from two flat tier streams:
+            per-entry flag bit 1 -> stream ``a_plane``, 0 -> ``b_vals``
+            (already decoded [capB] i32).  Returns (ids2d, valid,
+            a_pos2d, is_a, is_b, entry2d) for consumers that also need
+            the tier ranks (the cold consolidation)."""
+            rp = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)]
+            )
+            colj = jnp.arange(width, dtype=jnp.int32)[None, :]
+            entry = rp[:-1, None] + colj
+            valid = colj < counts[:, None]
+            cap = flags_plane.shape[0] * 8
+            e = jnp.clip(entry, 0, max(cap - 1, 0))
+            if cap == 0:
+                z = jnp.zeros((b, width), jnp.int32)
+                return z, valid, z, z > 0, z > 0
+            f = bits(flags_plane, cap)
+            a_pos = jnp.cumsum(f) - 1
+            b_pos = jnp.cumsum(1 - f) - 1
+            fe = f[e]
+            cap_a = a_plane.shape[0]
+            cap_b = b_vals.shape[0]
+            av = (
+                a_plane[jnp.clip(a_pos[e], 0, cap_a - 1)].astype(
+                    jnp.int32
+                )
+                if cap_a
+                else jnp.zeros((b, width), jnp.int32)
+            )
+            bv = (
+                b_vals[jnp.clip(b_pos[e], 0, cap_b - 1)]
+                if cap_b
+                else jnp.zeros((b, width), jnp.int32)
+            )
+            is_a = valid & (fe == 1)
+            is_b = valid & (fe == 0)
+            ids = jnp.where(is_a, av, jnp.where(is_b, bv, 0))
+            return ids, valid, av, is_a, is_b
+
+        def flat_slots(plane, counts, width):
+            rp = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)]
+            )
+            colj = jnp.arange(width, dtype=jnp.int32)[None, :]
+            valid = colj < counts[:, None]
+            cap = plane.shape[0]
+            if cap == 0:
+                return jnp.zeros((b, width), jnp.int32)
+            e = jnp.clip(rp[:-1, None] + colj, 0, cap - 1)
+            return jnp.where(valid, plane[e].astype(jnp.int32), 0)
+
+        cc = w["cw_cc"].astype(jnp.int32)
+        tail_keys = keys_plane(w["cw_ct"])
+        # cold: tier A = dictionary indices (resolved through cw_cu),
+        # tier B = raw tail keys
+        di2d, cvalid, di_raw, is_dict, is_tail = tiered(
+            cc, w["cw_cf"], w["cw_ci"], tail_keys, kc
+        )
+        cu = keys_plane(w["cw_cu"])
+        cap_d = cu.shape[0]
+        nd = w["cw_cun"][0]
+        if cap_d:
+            dict_key2d = cu[jnp.clip(di_raw, 0, cap_d - 1)]
+            keys2d = jnp.where(
+                is_dict, dict_key2d, jnp.where(is_tail, di2d, 0)
+            )
+            dict_keys_eff = jnp.where(
+                jnp.arange(cap_d) < nd, cu, t_sent
+            )
+        else:
+            keys2d = jnp.where(is_tail, di2d, 0)
+            dict_keys_eff = cu
+        cmask = cvalid.astype(jnp.float32)
+        out = {
+            "keys": keys2d,
+            "slots": (
+                flat_slots(w["cw_cs"], cc, kc)
+                if "cw_cs" in w
+                else jnp.zeros_like(keys2d)
+            ),
+            "vals": cmask,
+            "mask": cmask,
+            "labels": bits(w["cw_lb"], b).astype(jnp.float32),
+            "weights": bits(w["cw_wb"], b).astype(jnp.float32),
+            # the host-computed consolidation plan (Config.wire_dedup):
+            # occurrence -> dictionary slot (cap_d = dump for padding
+            # and tail), tail occurrences sentinel-coded for a direct
+            # drop-mode scatter, dictionary slot -> table row
+            "cold_uidx": jnp.where(is_dict, di_raw, cap_d),
+            "cold_tail_keys": jnp.where(is_tail, di2d, t_sent),
+            "cold_dict_keys": dict_keys_eff,
+        }
+        if "cw_hc" in w:
+            kh = cfg.hot_nnz
+            hc = w["cw_hc"].astype(jnp.int32)
+            if w["cw_hxh"].shape[0]:  # u12 tier: u8 lows + nibble highs
+                hib = w["cw_hxh"].astype(jnp.int32)
+                hi = jnp.stack(
+                    [hib & 0xF, hib >> 4], axis=1
+                ).reshape(-1)[: w["cw_hx"].shape[0]]
+                hx_vals = w["cw_hx"].astype(jnp.int32) | (hi << 8)
+            else:
+                hx_vals = w["cw_hx"].astype(jnp.int32)
+            hot2d, hvalid, _, _, _ = tiered(
+                hc, w["cw_hf"], w["cw_h8"], hx_vals, kh
+            )
+            hmask = hvalid.astype(jnp.float32)
+            out["hot_keys"] = hot2d
+            out["hot_slots"] = (
+                flat_slots(w["cw_hs"], hc, kh)
+                if "cw_hs" in w
+                else jnp.zeros_like(hot2d)
+            )
+            out["hot_vals"] = hmask
+            out["hot_mask"] = hmask
+        return out
+
     def _expand_wire(self, batch: BatchArrays) -> BatchArrays:
         """Inverse of batch_to_compact, inside the jitted step: padding
         is key == -1; real entries have val = mask = 1 (hash mode);
         slots widen from the u8 plane when the model reads them, else
-        reconstruct as zeros."""
+        reconstruct as zeros.  Dictionary-wire batches (cw_* planes,
+        Config.wire_dedup) decode through _expand_dict_wire instead."""
+        if "cw_cc" in batch:
+            return self._expand_dict_wire(batch)
         if "ckeys" not in batch:
             return batch
         ckeys = batch["ckeys"]
@@ -377,6 +641,7 @@ class TrainStep:
                     t["param"][:h],
                     batch["hot_keys"].reshape(-1),
                     dtype=self._hot_dtype,
+                    impl=self._hot_impl,
                 ).reshape(b, kh, d)
             else:
                 # opted-out table (TableSpec.hot=False): hot rows are
@@ -516,18 +781,39 @@ class TrainStep:
         batch: BatchArrays,
         occ_grads: dict,
         gbufs: dict,
+        dict_plan: dict | None = None,
     ) -> dict:
         """Accumulate per-occurrence grads into dense [T, D] buffers
         (one per table): scatter-add for the cold section, two-level
-        one-hot MXU matmuls for the hot section (ops/hot.py)."""
+        one-hot MXU matmuls for the hot section (ops/hot.py).
+
+        With ``dict_plan`` (the dict wire's host-computed dictionary,
+        Config.wire_dedup + cold_consolidate) the duplicated cold HEAD
+        consolidates by segment-sum over the shipped u16 indices — U
+        unique big-table slices instead of one per occurrence, and no
+        device argsort — while the near-unique tail keeps the direct
+        drop-mode scatter (consolidating it would cost more than it
+        collapses; io/compact.py)."""
         cfg = self.cfg
         kh = batch["hot_keys"].shape[1] if "hot_keys" in batch else 0
-        keys_eff = self._cold_keys_eff(batch)
+        use_dict = (
+            dict_plan is not None
+            and "cold_uidx" in dict_plan
+            and cfg.cold_consolidate
+        )
         plan = None
-        if cfg.cold_consolidate:
-            # one shared argsort over the cold keys; every table's
-            # gradients ride the same permutation/segments
-            plan = consolidate_plan(keys_eff, cfg.table_size)
+        if use_dict:
+            uidx = dict_plan["cold_uidx"].reshape(-1)
+            tail_eff = dict_plan["cold_tail_keys"].reshape(-1)
+            dict_keys_eff = dict_plan["cold_dict_keys"]
+            cap_d = dict_keys_eff.shape[0]
+            keys_eff = None
+        else:
+            keys_eff = self._cold_keys_eff(batch)
+            if cfg.cold_consolidate:
+                # one shared argsort over the cold keys; every table's
+                # gradients ride the same permutation/segments
+                plan = consolidate_plan(keys_eff, cfg.table_size)
         if kh:
             from xflow_tpu.ops.hot import hot_scatter
 
@@ -541,14 +827,22 @@ class TrainStep:
                 # buffer; cold grads keep the DMA scatter path.
                 hot_g = occ[:, :kh].reshape(-1, d)
                 occ = occ[:, kh:]
-            gbuf = self._cold_accumulate(
-                gbufs[name], keys_eff, occ.reshape(-1, d), plan
-            )
+            if use_dict:
+                occ_flat = occ.reshape(-1, d)
+                gsum = consolidate_indexed(occ_flat, uidx, cap_d)
+                gbuf = gbufs[name].at[dict_keys_eff].add(
+                    gsum, mode="drop"
+                )
+                gbuf = gbuf.at[tail_eff].add(occ_flat, mode="drop")
+            else:
+                gbuf = self._cold_accumulate(
+                    gbufs[name], keys_eff, occ.reshape(-1, d), plan
+                )
             if kh:
                 if self._mxu_hot[name]:
                     ghot = hot_scatter(
                         hot_keys_eff, hot_g, cfg.hot_size,
-                        dtype=self._hot_dtype,
+                        dtype=self._hot_dtype, impl=self._hot_impl,
                     )
                     gbuf = gbuf.at[: cfg.hot_size].add(ghot)
                 else:
@@ -563,6 +857,15 @@ class TrainStep:
     ) -> tuple[State, dict[str, jax.Array]]:
         cfg = self.cfg
         batch = self._expand_wire(batch)
+        # The dict wire's host consolidation plan has no batch leading
+        # axis, so it cannot ride _interleaved_slices; only the plain
+        # dense whole-batch scatter consumes it (via _scatter_grads) —
+        # every other path trains on the reconstructed key planes.
+        dict_plan = {
+            k: batch.pop(k)
+            for k in ("cold_uidx", "cold_tail_keys", "cold_dict_keys")
+            if k in batch
+        }
         if cfg.update_mode == "sequential" and cfg.microbatch > 1:
             return self._train_sequential(state, batch)
 
@@ -602,7 +905,9 @@ class TrainStep:
             pctr, occ_grads, grad_dense = self._forward_grads(
                 tables, dense, batch, num_real
             )
-            gbufs = self._scatter_grads(tables, batch, occ_grads, gbufs)
+            gbufs = self._scatter_grads(
+                tables, batch, occ_grads, gbufs, dict_plan=dict_plan
+            )
             ll = logloss(batch["labels"], pctr, batch["weights"])
             cnt = jnp.sum(batch["weights"])
         else:
@@ -745,7 +1050,8 @@ class TrainStep:
             }
             if kh:
                 ghot = hot_scatter(
-                    hot_keys_eff, hot_g, hsize, dtype=self._hot_dtype
+                    hot_keys_eff, hot_g, hsize,
+                    dtype=self._hot_dtype, impl=self._hot_impl,
                 )
                 # non-hot slots carry index H -> dropped; no mask needed
                 ghot = ghot.at[ukeys_hotpart].add(gsum, mode="drop")
@@ -912,6 +1218,7 @@ class TrainStep:
                     head["param"],
                     bslice["hot_keys"].reshape(-1),
                     dtype=self._hot_dtype,
+                    impl=self._hot_impl,
                 ).reshape(b, kh, d)
                 rows[name] = jnp.concatenate(
                     [hot, cold_slice[name]], axis=1
@@ -928,7 +1235,8 @@ class TrainStep:
                 hot_g = g[:, :kh].reshape(-1, d)
                 cold_occ[name] = g[:, kh:]
                 ghot = hot_scatter(
-                    hot_keys_eff, hot_g, h, dtype=self._hot_dtype
+                    hot_keys_eff, hot_g, h,
+                    dtype=self._hot_dtype, impl=self._hot_impl,
                 )
                 new_heads[name] = self.optimizer.update_rows(head, ghot)
             new_dense = self._apply_dense_sgd(dense_c, gd)
@@ -1004,6 +1312,8 @@ class TrainStep:
     def _predict_impl(self, state: State, batch: BatchArrays) -> jax.Array:
         """pctr per example (reference calculate_pctr, lr_worker.cc:46-61)."""
         batch = self._expand_wire(batch)
+        for k in ("cold_uidx", "cold_tail_keys", "cold_dict_keys"):
+            batch.pop(k, None)  # predict has no scatter to plan for
         rows = self._gather_model_rows(state["tables"], batch)
         return sigmoid_ref(
             self._logit(rows, self._model_view(batch), state["dense"])

@@ -101,8 +101,22 @@ class PredictEngine:
             )
         self.model = make_model(cfg)
         # The predict path never touches the optimizer; TrainStep is
-        # reused purely for its wire/gather/logit machinery.
-        self.step = TrainStep(self.model, None, cfg, self.mesh)
+        # reused purely for its wire/gather/logit machinery.  Serving
+        # pins the dictionary wire OFF (Config.wire_dedup is a
+        # training-feed lever): its plane capacities are content-sized
+        # (io/compact.py plane_cap), which would key the AOT executable
+        # cache on per-request nnz totals and break the
+        # one-compile-per-bucket guarantee compile_count enforces.
+        # Request batches are tiny — the plain compact wire is already
+        # ~free at serving sizes; the hot-impl platform pick (ops/hot.py)
+        # still applies to the featurize->predict path.  The override
+        # rides the STEP's config copy (self.cfg — the artifact's
+        # digest-locked identity — is untouched) so a wire_dedup='on'
+        # training config still serves on any mesh, where TrainStep's
+        # single-device eligibility check would otherwise refuse it.
+        self.step = TrainStep(
+            self.model, None, cfg.replace(wire_dedup="off"), self.mesh
+        )
         self.remap = remap
         self.obs = obs if obs is not None else NULL_OBS
         self.step.obs = self.obs

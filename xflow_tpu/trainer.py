@@ -85,6 +85,10 @@ class Trainer:
         self.host = jax.process_index()
         self.num_hosts = jax.process_count()
         self._global_steps = 0  # across epochs; drives the profile trigger
+        # Live loader prefetch iterators (io/loader.py::_PrefetchIter),
+        # closed explicitly by close() so abandoned producer threads
+        # (crash, preemption, consumer break) never outlive the Trainer.
+        self._live_prefetch: set = set()
         # Observability (obs/__init__.py): a live tracer/registry bundle
         # when metrics or tracing is requested, else the shared no-op
         # NULL_OBS (zero per-step allocation).  Threaded into the step
@@ -236,6 +240,9 @@ class Trainer:
         call this) to cover every other exit."""
         if self._watchdog is not None:
             self._watchdog.stop()
+        for it in list(self._live_prefetch):
+            it.close()
+        self._live_prefetch.clear()
         if (
             self._flight is not None
             and self._flight_reason is not None
@@ -351,7 +358,19 @@ class Trainer:
             hot_size=cfg.hot_size,
             hot_nnz=cfg.hot_nnz,
             obs=self.obs,
+            # v2 packed shards skip expansion AND re-compaction when
+            # the step consumes the dict wire (io/compact.py)
+            emit_compact=self.step.dict_wire,
         )
+
+    def _tracked_prefetch(self, loader: ShardLoader, depth, offset, workers):
+        """loader.prefetch registered for explicit shutdown: the
+        producer thread (and its open shard file) dies on
+        Trainer.close() even if the consumer abandoned the iterator
+        mid-shard (crash/preemption) — not whenever the GC notices."""
+        it = loader.prefetch(depth, offset, workers)
+        self._live_prefetch.add(it)
+        return it
 
     def _parse_workers(self) -> int:
         w = self.cfg.parse_workers
@@ -381,16 +400,21 @@ class Trainer:
             loader = self._loader(path)
             workers = self._parse_workers()
             it = (
-                loader.prefetch(depth, offset, workers)
+                self._tracked_prefetch(loader, depth, offset, workers)
                 if depth
                 else loader.iter_batches(offset, workers)
             )
             t_shard = time.perf_counter()
             examples = 0
-            for batch, resume in it:
-                examples += batch.num_real()
-                self._note_batch_shape(batch, si)
-                yield batch, si, resume
+            try:
+                for batch, resume in it:
+                    examples += batch.num_real()
+                    self._note_batch_shape(batch, si)
+                    yield batch, si, resume
+            finally:
+                if depth:
+                    it.close()
+                    self._live_prefetch.discard(it)
             dt = time.perf_counter() - t_shard
             if self.metrics_logger is not None:
                 self.metrics_logger.log("shard", {
@@ -478,17 +502,22 @@ class Trainer:
                 yield pad, last[0], last[1]
 
     def _transfer_ahead(
-        self, it: Iterator[tuple[Batch, int, int]], depth: int = 2
+        self, it: Iterator[tuple[Batch, int, int]], depth: int | None = None
     ) -> Iterator[tuple[Any, int, int]]:
-        """Run put_batch (host->device transfer) ``depth`` items ahead on
-        a worker thread so link round-trips overlap device compute —
-        measured 2-3x e2e on the tunneled link (docs/PERF.md).
-        Single-host only: multi-host put_batch is collective
-        (host_local_array_to_global_array) and must stay on the voting
-        thread."""
+        """Device staging ring: run put_batch (host-side compaction +
+        h2d transfer) up to ``depth`` (Config.transfer_ahead, >= 2 for
+        double buffering) items ahead on worker threads so link
+        round-trips AND per-batch compaction overlap device compute —
+        measured 2-3x e2e on the tunneled link (docs/PERF.md).  Two
+        workers when the ring is deep enough, so one batch can compact
+        while another is on the wire.  Single-host only: multi-host
+        put_batch is collective (host_local_array_to_global_array) and
+        must stay on the voting thread."""
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(1) as ex:
+        if depth is None:
+            depth = self.cfg.transfer_ahead
+        with ThreadPoolExecutor(min(2, depth)) as ex:
             pending: deque = deque()
             for batch, si, resume in it:
                 pending.append(
@@ -676,6 +705,25 @@ class Trainer:
         occ = snap.hists.get("transfer_ahead_depth")
         if occ:
             stats["transfer_ahead_depth_mean"] = round(occ["mean"], 3)
+        if "wire.bytes" in snap.counters:
+            # host->device wire accounting (parallel/step.py::_book_wire)
+            # -> the epoch's `wire` metrics row; compaction_ratio = cold
+            # occurrences per big-table touch the dict wire left (1.0 =
+            # no dedup happened / plain wire)
+            touched = snap.counters.get("wire.cold_touched", 0)
+            occ_in = snap.counters.get("wire.cold_occ", 0)
+            stats["_wire"] = {
+                "epoch": self.epoch,
+                "format": self.step.wire_format,
+                "wire_bytes_per_example": round(
+                    snap.counters["wire.bytes"]
+                    / max(snap.counters.get("wire.examples", 0), 1),
+                    2,
+                ),
+                "compaction_ratio": round(
+                    occ_in / touched if touched else 1.0, 3
+                ),
+            }
         if "loader.parse_bytes" in snap.counters:
             stats["parse_mb_per_sec"] = round(
                 snap.counters["loader.parse_bytes"] / 2**20
@@ -702,9 +750,12 @@ class Trainer:
                 start_shard, start_offset = self._resume_cursor
                 self._resume_cursor = (0, 0)
                 stats = self.train_epoch(start_shard, start_offset)
+                wire_stats = stats.pop("_wire", None)
                 history.append(stats)
                 if self.metrics_logger is not None:
                     self.metrics_logger.log("train_epoch", stats)
+                    if wire_stats is not None:
+                        self.metrics_logger.log("wire", wire_stats)
                 self._log_device_mem()
                 if self.epoch % 30 == 0 or self.epoch == self.cfg.epochs - 1:
                     self._log(
@@ -828,10 +879,15 @@ class Trainer:
                 # Reference predict uses doubled block size (lr_worker.cc:80).
                 loader = self._loader(path)
                 loader.block_bytes = (cfg.block_mib * 2) << 20
-                for batch, resume in loader.prefetch(
-                    cfg.prefetch_batches, parse_workers=workers
-                ):
-                    yield batch, 0, resume
+                it = self._tracked_prefetch(
+                    loader, cfg.prefetch_batches, 0, workers
+                )
+                try:
+                    for batch, resume in it:
+                        yield batch, 0, resume
+                finally:
+                    it.close()
+                    self._live_prefetch.discard(it)
 
         try:
             # predict is collective too — keep hosts step-aligned
