@@ -1,7 +1,9 @@
 """Static-analysis gate: run the xflow_tpu.analysis rule pass (XF001
 recompile hazards, XF002 hidden host syncs, XF003 lock discipline,
-XF004 schema drift, XF005 C-ABI parity — docs/ANALYSIS.md) over the
-whole package against the committed baseline.
+XF004 schema drift, XF005 C-ABI parity, and the XF006–XF009
+concurrency rules — docs/ANALYSIS.md) over the whole package against
+the committed baseline.  scripts/check_concurrency.py re-runs the
+concurrency subset plus the runtime lock-order sanitizer cross-check.
 
 Run from the repo root:
 

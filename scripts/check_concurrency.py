@@ -1,0 +1,170 @@
+"""Concurrency gate: the XF006–XF009 static pass PLUS a runtime
+lock-order sanitizer smoke, gating the thread fabric before the
+N-stream input fan-out (ROADMAP item 1) multiplies it.
+
+Run from the repo root:
+
+    python scripts/check_concurrency.py
+
+Two halves, both must pass:
+
+1. **Static** — ``xflow_tpu.analysis`` with the four concurrency rules
+   (XF006 thread lifecycle, XF007 lock order, XF008 shared-state
+   discipline, XF009 heartbeat coverage — docs/ANALYSIS.md) over the
+   whole package against the committed baseline, same contract as
+   scripts/check_analysis.py.
+2. **Runtime** — arm the lock-order sanitizer
+   (analysis/sanitizer.py) over a live MicroBatcher + MetricsLogger +
+   MetricsRegistry, push concurrent traffic through them, and
+   cross-check every OBSERVED lock-acquisition order against the
+   static XF007 graph.  An observed order that contradicts the static
+   model (a cycle in the combined graph) fails the gate: the code
+   takes locks in an order the analysis says can deadlock.
+
+Wired into tier-1 via tests/test_analysis.py, next to
+check_analysis.py / check_metrics_schema.py / check_serve_smoke.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+CONCURRENCY_RULES = ["XF006", "XF007", "XF008", "XF009"]
+
+
+def check_static(package: str, baseline_path: str) -> int:
+    from xflow_tpu.analysis import (
+        load_baseline,
+        render_text,
+        run_analysis,
+        split_baselined,
+    )
+
+    findings, pragma_suppressed = run_analysis(
+        [package], select=CONCURRENCY_RULES
+    )
+    entries = [
+        e
+        for e in load_baseline(baseline_path)
+        if e["rule"] in CONCURRENCY_RULES
+    ]
+    new, grandfathered, stale = split_baselined(findings, entries)
+    print(render_text(new, grandfathered, pragma_suppressed, stale))
+    if new:
+        return 1
+    if stale:
+        print(
+            "FAIL: stale baseline entries (prune analysis-baseline.json)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+class _EchoEngine:
+    """Engine stub for the smoke (no jax): pctr == the request's key."""
+
+    buckets = (1, 8)
+    digest = "smoke000"
+
+    def featurize(self, rows):
+        return [keys for keys, _, _ in rows]
+
+    def predict_prepared(self, batch):
+        return [float(k[0]) for k in batch]
+
+
+def check_runtime(package: str) -> int:
+    """Exercise the real lock users under the sanitizer and cross-check
+    observed acquisition orders against the static XF007 graph."""
+    from xflow_tpu.analysis import LockOrderSanitizer, static_lock_order
+    from xflow_tpu.obs.registry import MetricsRegistry
+    from xflow_tpu.serve.batcher import MicroBatcher
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    static = static_lock_order([package])
+    san = LockOrderSanitizer()
+    with tempfile.TemporaryDirectory() as tmp:
+        logger = MetricsLogger(os.path.join(tmp, "smoke.jsonl"))
+        registry = MetricsRegistry()
+        batcher = MicroBatcher(
+            _EchoEngine(),
+            max_wait_ms=0.5,
+            registry=registry,
+            metrics_logger=logger,
+        )
+        san.instrument(logger, "_lock", "MetricsLogger._lock")
+        san.instrument(registry, "_lock", "MetricsRegistry._lock")
+        san.instrument(batcher, "_swap_lock", "MicroBatcher._swap_lock")
+        san.instrument(
+            batcher, "_submit_lock", "MicroBatcher._submit_lock"
+        )
+        n_threads, per_thread = 4, 32
+        barrier = threading.Barrier(n_threads)
+        errors: list[BaseException] = []
+
+        def worker(tid: int) -> None:
+            try:
+                barrier.wait()
+                futs = [
+                    batcher.submit([float(tid * per_thread + i)])
+                    for i in range(per_thread)
+                ]
+                for f in futs:
+                    f.result(timeout=30)
+                from xflow_tpu.obs.schema import health_row
+
+                logger.log("health", health_row(
+                    cause="smoke", channel="serve",
+                    silence_seconds=0.0, threshold_seconds=0.0,
+                    detail="sanitizer smoke",
+                ))
+            except BaseException as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        batcher.close()
+        logger.close()
+        if errors:
+            print(f"FAIL: sanitizer smoke errored: {errors[0]!r}")
+            return 1
+    observed = san.edges()
+    contradictions = san.contradictions(static)
+    n_obs = sum(len(v) for v in observed.values())
+    n_static = sum(len(v) for v in static.values())
+    print(
+        f"sanitizer smoke: {n_obs} observed lock-order edge(s) vs "
+        f"{n_static} static edge(s)"
+    )
+    if contradictions:
+        for c in contradictions:
+            print(f"FAIL: observed lock order contradicts XF007: {c}")
+        return 1
+    print("OK: observed lock orders consistent with the static graph")
+    return 0
+
+
+def main() -> int:
+    package = os.path.join(REPO, "xflow_tpu")
+    baseline = os.path.join(REPO, "analysis-baseline.json")
+    rc = check_static(package, baseline)
+    rc = check_runtime(package) or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

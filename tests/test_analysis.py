@@ -1,7 +1,9 @@
 """xflow_tpu.analysis: rule-engine fixtures (every rule fires on its
 minimal repro and stays silent on the idiomatic pattern), pragma +
-baseline round-trips, the CLI/JSON contract, the tier-1 gate script,
-and the lock-stress runtime companion backing XF003 (docs/ANALYSIS.md).
+baseline round-trips, the CLI/JSON contract (incl. --changed-only), the
+tier-1 gate scripts (check_analysis + check_concurrency), the
+lock-stress runtime companion backing XF003, and the sanitizer-armed
+lock-order cross-check backing XF007 (docs/ANALYSIS.md).
 """
 
 from __future__ import annotations
@@ -600,12 +602,24 @@ def test_lock_stress_microbatcher_no_lost_updates(n_threads):
     """Hammer MicroBatcher from >= 8 threads with a barrier start: every
     future resolves to ITS request's value (no crossed futures), the
     stats counters account for every request exactly once, and
-    concurrent close() calls all return the same final row."""
+    concurrent close() calls all return the same final row.
+
+    The stress runs SANITIZER-ARMED (analysis/sanitizer.py): every
+    lock acquisition order actually taken under contention is recorded
+    and must be consistent with the static XF007 graph — the runtime
+    half of the concurrency gate (docs/ANALYSIS.md)."""
+    from xflow_tpu.analysis import LockOrderSanitizer, static_lock_order
     from xflow_tpu.serve.batcher import MicroBatcher
 
     per_thread = 50
     total = n_threads * per_thread
     batcher = MicroBatcher(_FakeEngine(), max_wait_ms=0.5)
+    san = LockOrderSanitizer()
+    san.instrument(batcher, "_submit_lock", "MicroBatcher._submit_lock")
+    san.instrument(batcher, "_swap_lock", "MicroBatcher._swap_lock")
+    san.instrument(
+        batcher.registry, "_lock", "MetricsRegistry._lock"
+    )
     barrier = threading.Barrier(n_threads)
     results: dict[int, list] = {}
     errors: list[BaseException] = []
@@ -652,6 +666,610 @@ def test_lock_stress_microbatcher_no_lost_updates(n_threads):
     stats = closed[0]
     assert stats["requests"] == total
     assert 1 <= stats["batches"] <= total
+    # the orders the stress ACTUALLY took must not contradict the
+    # static XF007 lock graph (acceptance criterion, ISSUE 6)
+    static = static_lock_order([os.path.join(REPO, "xflow_tpu")])
+    assert san.contradictions(static) == []
+
+
+# -- XF006: thread lifecycle ----------------------------------------------
+
+_XF006_NO_JOIN = (
+    "import threading\n"
+    "class W:\n"
+    "    def start(self):\n"
+    "        self._t = threading.Thread(target=self._run)\n"
+    "        self._t.start()\n"
+    "    def _run(self):\n"
+    "        pass\n"
+)
+
+
+def test_xf006_started_thread_without_join_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": _XF006_NO_JOIN},
+                       select=["XF006"])
+    assert len(findings) == 1
+    assert "no join" in findings[0].message
+
+
+def test_xf006_join_without_timeout_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        _XF006_NO_JOIN
+        + "    def close(self):\n"
+        + "        self._t.join()\n"
+    )}, select=["XF006"])
+    assert len(findings) == 1
+    assert "without a timeout" in findings[0].message
+
+
+def test_xf006_fire_and_forget_local_thread_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import threading\n"
+        "def fire(fn):\n"
+        "    t = threading.Thread(target=fn)\n"
+        "    t.start()\n"
+    )}, select=["XF006"])
+    assert len(findings) == 1
+    assert "never" in findings[0].message
+
+
+def test_xf006_executor_without_shutdown_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._ex = ThreadPoolExecutor(2)\n"
+    )}, select=["XF006"])
+    assert len(findings) == 1
+    assert "shutdown" in findings[0].message
+
+
+def test_xf006_str_join_does_not_satisfy_thread_join(tmp_path):
+    """Regression: ', '.join(parts) in close() is a STRING join — it
+    must not pass for the started thread's shutdown join."""
+    findings, _ = scan(tmp_path, {"mod.py": (
+        _XF006_NO_JOIN
+        + "    def close(self):\n"
+        + "        return ', '.join(['a', 'b'])\n"
+    )}, select=["XF006"])
+    assert len(findings) == 1
+    assert "no join" in findings[0].message
+
+
+def test_xf006_silent_on_disciplined_shutdown(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import threading\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class W:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n"
+        "        self._ex = ThreadPoolExecutor(2)\n"
+        "    def _run(self):\n"
+        "        pass\n"
+        "    def close(self):\n"
+        "        self._t.join(timeout=5.0)\n"
+        "        self._ex.shutdown()\n"
+        "def pooled(items, fn):\n"
+        "    with ThreadPoolExecutor(4) as ex:\n"
+        "        return [f.result(timeout=60)\n"
+        "                for f in [ex.submit(fn, i) for i in items]]\n"
+    )}, select=["XF006"])
+    assert findings == []
+
+
+# -- XF007: lock order -----------------------------------------------------
+
+
+def test_xf007_lexical_lock_order_cycle_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import threading\n"
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def ab(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def ba(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )}, select=["XF007"])
+    assert len(findings) == 1
+    assert "lock-order cycle" in findings[0].message
+    assert "AB._a" in findings[0].message and "AB._b" in findings[0].message
+
+
+def test_xf007_multi_item_with_cycle_fires(tmp_path):
+    """Regression: `with self._a, self._b:` acquires left-to-right —
+    the a->b edge must come from the ACCUMULATING held set, so the
+    reversed nested order elsewhere still closes the cycle."""
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import threading\n"
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def ab(self):\n"
+        "        with self._a, self._b:\n"
+        "            pass\n"
+        "    def ba(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )}, select=["XF007"])
+    assert len(findings) == 1
+    assert "lock-order cycle" in findings[0].message
+
+
+def test_xf007_interprocedural_cycle_through_calls_fires(tmp_path):
+    # a() holds _a and calls a helper that takes _b; b() holds _b and
+    # calls one that takes _a — no single function shows the cycle
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def a(self):\n"
+        "        with self._a:\n"
+        "            self._grab_b()\n"
+        "    def _grab_b(self):\n"
+        "        with self._b:\n"
+        "            pass\n"
+        "    def b(self):\n"
+        "        with self._b:\n"
+        "            self._grab_a()\n"
+        "    def _grab_a(self):\n"
+        "        with self._a:\n"
+        "            pass\n"
+    )}, select=["XF007"])
+    assert len(findings) == 1
+    assert "lock-order cycle" in findings[0].message
+
+
+def test_xf007_self_deadlock_lock_fires_rlock_silent(tmp_path):
+    src = (
+        "import threading\n"
+        "class {cls}:\n"
+        "    def __init__(self):\n"
+        "        self._m = threading.{ctor}()\n"
+        "    def nest(self):\n"
+        "        with self._m:\n"
+        "            with self._m:\n"
+        "                pass\n"
+    )
+    findings, _ = scan(tmp_path, {
+        "plain.py": src.format(cls="SPlain", ctor="Lock"),
+        "reent.py": src.format(cls="SReent", ctor="RLock"),
+    }, select=["XF007"])
+    # the non-reentrant Lock self-nest fires; the RLock one is legal
+    assert len(findings) == 1
+    assert findings[0].path == "plain.py"
+    assert "re-acquired" in findings[0].message
+
+
+def test_xf007_blocking_call_under_lock_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import threading\n"
+        "import queue\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue()\n"
+        "    def bad(self):\n"
+        "        with self._lock:\n"
+        "            return self._q.get()\n"
+        "    def ok(self, fut):\n"
+        "        with self._lock:\n"
+        "            a = self._q.get(timeout=1.0)\n"
+        "        b = self._q.get()\n"
+        "        return a, b, fut.result(timeout=5)\n"
+    )}, select=["XF007"])
+    assert len(findings) == 1
+    assert ".get() without a timeout" in findings[0].message
+    assert "Q._lock" in findings[0].message
+
+
+def test_xf007_consistent_order_is_silent(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import threading\n"
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+    )}, select=["XF007"])
+    assert findings == []
+
+
+def test_static_lock_order_exports_edges(tmp_path):
+    from xflow_tpu.analysis import static_lock_order
+
+    (tmp_path / "mod.py").write_text(
+        "import threading\n"
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def ab(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+    )
+    assert static_lock_order([str(tmp_path)]) == {"AB._a": ["AB._b"]}
+
+
+# -- XF008: shared-state discipline ---------------------------------------
+
+_XF008_POSITIVE = (
+    "import threading\n"
+    "class Shared:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._t = threading.Thread(target=self._work)\n"
+    "        self._latest = None\n"
+    "    def _work(self):\n"
+    "        self._latest = 1\n"
+    "    def read(self):\n"
+    "        return self._latest\n"
+)
+
+
+def test_xf008_unguarded_cross_context_state_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": _XF008_POSITIVE},
+                       select=["XF008"])
+    # both the worker write and the main read are unguarded sites
+    assert len(findings) == 2
+    assert all("_latest" in f.message for f in findings)
+    kinds = {("written" in f.message, "read" in f.message)
+             for f in findings}
+    assert len(kinds) == 2
+
+
+def test_xf008_guarded_or_handed_off_is_silent(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import threading\n"
+        "import queue\n"
+        "class Shared:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._latest = None\n"
+        "        self._q = queue.Queue()\n"
+        "        self._cfg = 42\n"
+        "    def _work(self):\n"
+        "        with self._lock:\n"
+        "            self._latest = 1\n"
+        "        self._q.put(self._cfg)\n"  # queue hand-off + init-only read
+        "    def read(self):\n"
+        "        with self._lock:\n"
+        "            return self._latest\n"
+    )}, select=["XF008"])
+    assert findings == []
+
+
+def test_xf008_single_context_state_is_silent(tmp_path):
+    # written and read on the main side only: no cross-context race
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import threading\n"
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=self._work)\n"
+        "        self._n = 0\n"
+        "    def _work(self):\n"
+        "        pass\n"
+        "    def bump(self):\n"
+        "        self._n += 1\n"
+    )}, select=["XF008"])
+    assert findings == []
+
+
+def test_context_classification_both_contexts(tmp_path):
+    """A method both submitted to an executor AND plain-called is
+    classified worker AND main (the TrainStep.put_batch shape)."""
+    from xflow_tpu.analysis.core import PackageIndex
+    from xflow_tpu.analysis.rules_concurrency import get_context
+
+    (tmp_path / "mod.py").write_text(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class Step:\n"
+        "    def put(self, x):\n"
+        "        return x\n"
+        "def ring(step, items):\n"
+        "    with ThreadPoolExecutor(2) as ex:\n"
+        "        futs = [ex.submit(step.put, i) for i in items]\n"
+        "    return [f.result(timeout=5) for f in futs]\n"
+        "def inline(step, x):\n"
+        "    return step.put(x)\n"
+    )
+    ctx = get_context(PackageIndex([str(tmp_path)]))
+    put = next(f for f in ctx.fns if f.qualname == "Step.put")
+    assert put.is_worker and put.is_main
+
+
+# -- XF009: heartbeat coverage --------------------------------------------
+
+_XF009_TEMPLATE = (
+    "import threading\n"
+    "class Pump:\n"
+    "    def __init__(self, flight):\n"
+    "        self.flight = flight\n"
+    "        self._stop = threading.Event()\n"
+    "        self._t = threading.Thread(target=self._run)\n"
+    "    def _run(self):\n"
+    "        while not self._stop.is_set():\n"
+    "            {body}\n"
+    "    def beat(self):\n"
+    "        self.flight.note_loader('tick')\n"
+    "    def step(self):\n"
+    "        pass\n"
+)
+
+
+def test_xf009_silent_worker_loop_in_hot_module_fires(tmp_path):
+    findings, _ = scan(tmp_path, {
+        "io/pump.py": _XF009_TEMPLATE.format(body="self.step()"),
+    }, select=["XF009"])
+    assert len(findings) == 1
+    assert "heartbeat" in findings[0].message
+    assert "_run" in findings[0].message
+
+
+def test_xf009_heartbeat_through_call_closure_is_silent(tmp_path):
+    findings, _ = scan(tmp_path, {
+        "io/pump.py": _XF009_TEMPLATE.format(body="self.beat()"),
+    }, select=["XF009"])
+    assert findings == []
+
+
+def test_xf009_heartbeat_in_defined_but_uncalled_lambda_fires(tmp_path):
+    """Regression: a heartbeat referenced only inside a nested
+    def/lambda the loop DEFINES (never calls) is not a beat — the
+    scoped walk must not descend into it."""
+    findings, _ = scan(tmp_path, {
+        "io/pump.py": _XF009_TEMPLATE.format(
+            body="cb = lambda: self.flight.note_loader('t')"
+        ),
+    }, select=["XF009"])
+    assert len(findings) == 1
+    assert "heartbeat" in findings[0].message
+
+
+def test_xf009_bounded_loop_cold_module_main_context_silent(tmp_path):
+    findings, _ = scan(tmp_path, {
+        # bounded loop (comparison in the condition): not flagged
+        "io/bounded.py": (
+            "import threading\n"
+            "class P:\n"
+            "    def __init__(self):\n"
+            "        self._t = threading.Thread(target=self._run)\n"
+            "    def _run(self):\n"
+            "        n = 0\n"
+            "        while n < 10:\n"
+            "            n += 1\n"
+        ),
+        # unbounded worker loop, but in a COLD module
+        "utils/pump.py": _XF009_TEMPLATE.format(body="self.step()"),
+        # unbounded loop in a hot module, but main-context
+        "io/mainloop.py": (
+            "def drain(q):\n"
+            "    while True:\n"
+            "        if q.empty():\n"
+            "            return\n"
+        ),
+    }, select=["XF009"])
+    assert findings == []
+
+
+# -- runtime sanitizer (analysis/sanitizer.py) ----------------------------
+
+
+def test_sanitizer_records_orders_and_flags_contradictions():
+    from xflow_tpu.analysis import LockOrderSanitizer
+
+    san = LockOrderSanitizer()
+    wa = san.wrap(threading.Lock(), "A")
+    wb = san.wrap(threading.Lock(), "B")
+    with wa:
+        with wb:
+            pass
+    assert san.edges() == {"A": {"B"}}
+    # consistent with a static graph that has (or implies) A -> B
+    assert san.contradictions({"A": ["B"]}) == []
+    assert san.contradictions({}) == []
+    # the REVERSE observed order against static A -> B is a cycle the
+    # static graph alone does not contain: a contradiction
+    san2 = LockOrderSanitizer()
+    wa2 = san2.wrap(threading.Lock(), "A")
+    wb2 = san2.wrap(threading.Lock(), "B")
+    with wb2:
+        with wa2:
+            pass
+    out = san2.contradictions({"A": ["B"]})
+    assert len(out) == 1 and "A" in out[0] and "B" in out[0]
+
+
+def test_sanitizer_rlock_reentry_is_not_an_edge():
+    from xflow_tpu.analysis import LockOrderSanitizer
+
+    san = LockOrderSanitizer()
+    w = san.wrap(threading.RLock(), "R")
+    with w:
+        with w:
+            pass
+    assert san.edges() == {}
+    assert san.contradictions({}) == []
+
+
+def test_sanitizer_arming_is_opt_in():
+    from xflow_tpu.analysis.sanitizer import (
+        _InstrumentedLock,
+        armed,
+        maybe_instrument,
+    )
+
+    assert not armed({})
+    assert not armed({"XFLOW_LOCK_SANITIZER": "0"})
+    assert armed({"XFLOW_LOCK_SANITIZER": "1"})
+
+    class Holder:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+    h = Holder()
+    # unarmed: no wrapper is created, the plain lock stays
+    assert maybe_instrument(h, "_lock", environ={}) is None
+    assert isinstance(h._lock, type(threading.Lock()))
+    # armed via env: instrumented, and idempotent
+    got = maybe_instrument(
+        h, "_lock", environ={"XFLOW_LOCK_SANITIZER": "1"}
+    )
+    assert isinstance(got, _InstrumentedLock)
+    assert got.name == "Holder._lock"
+    again = maybe_instrument(
+        h, "_lock", environ={"XFLOW_LOCK_SANITIZER": "1"}
+    )
+    assert again is got
+
+
+def test_check_concurrency_script():
+    """The static+runtime concurrency gate passes on the shipped tree —
+    run exactly as CI does (same pattern as check_analysis)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_concurrency.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_changed_only_scopes_to_git_diff(tmp_path):
+    """--changed-only reports findings only for files changed vs HEAD
+    (the fast pre-commit mode); a committed violation elsewhere in the
+    tree no longer fails the scoped run."""
+    def git(*args):
+        proc = subprocess.run(
+            ["git", *args], cwd=str(tmp_path),
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stderr
+        return proc
+
+    git("init", "-q", ".")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (tmp_path / "committed.py").write_text(_XF003_POSITIVE)
+    git("add", "committed.py")
+    git("commit", "-qm", "seed")
+    proc = _run_cli(
+        [str(tmp_path), "--select", "XF003", "--changed-only",
+         "--format", "json"],
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["counts"]["new"] == 0
+    # an UNTRACKED file with a violation is in scope
+    (tmp_path / "fresh.py").write_text(_XF003_POSITIVE)
+    proc = _run_cli(
+        [str(tmp_path), "--select", "XF003", "--changed-only",
+         "--format", "json"],
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["counts"]["new"] == 1
+    assert doc["findings"][0]["path"] == "fresh.py"
+
+
+def test_cli_changed_only_from_subdirectory_sees_untracked(tmp_path):
+    """Regression: `git ls-files --others` prints paths relative to
+    its cwd — run from a SUBDIRECTORY, an untracked violation there
+    must still be in scope (the listing runs from the repo root)."""
+    def git(*args):
+        proc = subprocess.run(
+            ["git", *args], cwd=str(tmp_path),
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stderr
+        return proc
+
+    git("init", "-q", ".")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (tmp_path / "root.py").write_text("x = 1\n")
+    git("add", "root.py")
+    git("commit", "-qm", "seed")
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "fresh.py").write_text(_XF003_POSITIVE)
+    proc = _run_cli(
+        [".", "--select", "XF003", "--changed-only", "--format", "json"],
+        cwd=str(sub),
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["counts"]["new"] == 1
+    assert doc["findings"][0]["path"] == "fresh.py"
+
+
+def test_cli_changed_only_outside_git_is_usage_error(tmp_path):
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    proc = _run_cli(
+        [str(tmp_path), "--changed-only"], cwd=str(tmp_path)
+    )
+    assert proc.returncode == 2
+    assert "git" in proc.stderr
+
+
+def test_cli_changed_only_baseline_interactions(tmp_path):
+    """Regression: a scoped run must not misreport baseline entries of
+    UNCHANGED files as stale (their findings were filtered, not fixed),
+    and --changed-only --write-baseline is refused (a scoped write
+    would truncate the committed baseline)."""
+    def git(*args):
+        proc = subprocess.run(
+            ["git", *args], cwd=str(tmp_path),
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stderr
+        return proc
+
+    git("init", "-q", ".")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (tmp_path / "legacy.py").write_text(_XF003_POSITIVE)
+    # baseline grandfathers the committed legacy finding
+    proc = _run_cli([".", "--write-baseline"], cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr
+    git("add", "-A")
+    git("commit", "-qm", "seed with baseline")
+    # touch an UNRELATED file: the legacy entry must NOT surface stale
+    (tmp_path / "other.py").write_text("x = 1\n")
+    proc = _run_cli(
+        [".", "--changed-only", "--format", "json"], cwd=str(tmp_path)
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["counts"]["new"] == 0
+    assert doc["counts"]["stale_baseline"] == 0, doc
+    # the scoped-write footgun is refused outright
+    proc = _run_cli(
+        [".", "--changed-only", "--write-baseline"], cwd=str(tmp_path)
+    )
+    assert proc.returncode == 2
+    assert "write-baseline" in proc.stderr
 
 
 def test_lock_stress_metrics_registry_exact_counts():

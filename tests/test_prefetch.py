@@ -71,6 +71,89 @@ def test_prefetch_exception_propagates(tmp_path):
     assert _wait_dead(it)
 
 
+def test_prefetch_leak_surfaced_on_join_timeout(tmp_path):
+    """A producer wedged in parse/read (NOT on the queue) outlives the
+    close() join: the leak must be SURFACED — warning, counter, and a
+    ``health`` row `obs doctor` can rank — instead of silent
+    (io/loader.py satellite, ISSUE 6)."""
+    import json
+    import threading
+    import warnings
+
+    from xflow_tpu.obs import Obs
+    from xflow_tpu.obs.flight import FlightRecorder
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    release = threading.Event()
+
+    def wedged():
+        yield 1
+        release.wait()  # stuck mid-"parse", not on the queue
+        yield 2
+
+    path = str(tmp_path / "m.jsonl")
+    logger = MetricsLogger(path)
+    obs = Obs()
+    obs.flight = FlightRecorder(metrics_logger=logger)
+    it = _PrefetchIter(wedged(), depth=2, obs=obs)
+    assert next(it) == 1
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        it.close(join_timeout=0.1)
+    assert any(
+        "outlived" in str(w.message) for w in caught
+    ), [str(w.message) for w in caught]
+    snap = obs.registry.snapshot()
+    assert snap.counters.get("loader.leaked_threads") == 1
+    logger.close()
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    leak = [
+        r for r in rows
+        if r.get("kind") == "health"
+        and r.get("cause") == "prefetch_thread_leak"
+    ]
+    assert len(leak) == 1
+    assert leak[0]["channel"] == "loader"
+    # schema-valid: obs validate must accept the leak row
+    from xflow_tpu.obs.schema import validate_rows
+
+    assert validate_rows(leak) == []
+    # close() is idempotent: a second close (Trainer.close reaping
+    # _live_prefetch after a direct close) must neither pay another
+    # join_timeout nor double-report the leak
+    import time as _time
+
+    t0 = _time.monotonic()
+    it.close(join_timeout=5.0)
+    assert _time.monotonic() - t0 < 1.0
+    assert obs.registry.snapshot().counters.get(
+        "loader.leaked_threads"
+    ) == 1
+    # unwedge so the daemon producer exits before the test returns
+    release.set()
+    assert _wait_dead(it)
+
+
+def test_prefetch_clean_close_does_not_warn(toy_dataset):
+    """The normal close path stays silent: no leak warning, no
+    counter, no health row."""
+    import warnings
+
+    loader = ShardLoader(
+        toy_dataset.train_prefix + "-00000",
+        batch_size=16, max_nnz=24, table_size=1 << 14, block_mib=1,
+    )
+    it = loader.prefetch(depth=1)
+    next(it)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        it.close()
+    assert not any(
+        "outlived" in str(w.message) for w in caught
+    ), [str(w.message) for w in caught]
+
+
 def test_trainer_close_stops_live_prefetch(toy_dataset):
     """Abandon training mid-shard; Trainer.close() must reap the
     loader's producer thread."""

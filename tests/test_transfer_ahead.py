@@ -1,0 +1,120 @@
+"""Transfer-ahead staging ring under failure (ISSUE 6 satellite).
+
+trainer._transfer_ahead runs put_batch on ring workers; two failure
+shapes must stay bounded:
+
+* a worker RAISING mid-ring — the exception must propagate to the
+  caller, and Trainer.close() must complete without deadlocking or
+  stranding pending futures (the executor joins its in-flight
+  put_batch calls, which are bounded host work);
+* a ring ABANDONED mid-epoch (preemption break, consumer exception) —
+  close() must shut the executor down explicitly instead of leaving
+  its threads to the garbage collector (XF006, the _PrefetchIter leak
+  class, executor edition).
+
+Thread interleavings are shaken out with a lowered
+``sys.setswitchinterval``, alongside the sanitizer-armed lock-stress
+fixtures in tests/test_analysis.py.
+"""
+
+import sys
+import threading
+import time
+
+import pytest
+
+from xflow_tpu.config import Config
+from xflow_tpu.trainer import Trainer
+
+
+def _ring_threads() -> set[int]:
+    return {
+        th.ident
+        for th in threading.enumerate()
+        if th.name.startswith("ThreadPoolExecutor")
+    }
+
+
+def _wait_no_new_ring_threads(before: set[int], timeout: float = 15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaked = _ring_threads() - before
+        if not leaked:
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"transfer-ahead executor threads leaked: {_ring_threads() - before}"
+    )
+
+
+@pytest.fixture
+def trainer(toy_dataset):
+    cfg = Config(
+        model="lr",
+        train_path=toy_dataset.train_prefix,
+        batch_size=16,
+        table_size_log2=14,
+        max_nnz=24,
+        num_devices=1,
+        epochs=1,
+        transfer_ahead=2,
+    )
+    t = Trainer(cfg)
+    yield t
+    t.close()
+
+
+def test_worker_exception_mid_ring_no_deadlock(trainer):
+    """put_batch raising on a ring worker: train_epoch surfaces the
+    exception, close() returns promptly, no executor thread leaks, no
+    pending future left stranded."""
+    before = _ring_threads()
+    orig = trainer.step.put_batch
+    calls = []
+
+    def boom(batch):
+        calls.append(1)
+        if len(calls) == 3:
+            raise RuntimeError("worker exploded mid-ring")
+        return orig(batch)
+
+    trainer.step.put_batch = boom
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)  # shake out interleavings
+    try:
+        with pytest.raises(RuntimeError, match="mid-ring"):
+            trainer.train_epoch()
+    finally:
+        sys.setswitchinterval(old_interval)
+    t0 = time.time()
+    trainer.close()
+    assert time.time() - t0 < 30, "close() stalled after ring failure"
+    _wait_no_new_ring_threads(before)
+    # a later epoch on the same trainer still works (state not wedged)
+    trainer.step.put_batch = orig
+    stats = trainer.train_epoch()
+    assert stats["examples"] > 0
+
+
+def test_abandoned_ring_reaped_by_close(trainer):
+    """A suspended mid-epoch ring (the preemption-break shape) is shut
+    down by Trainer.close(), not left to the GC."""
+    before = _ring_threads()
+    stream = trainer._transfer_ahead(trainer.iter_train_batches())
+    trainer._live_transfer.add(stream)
+    arrays, shard_idx, _ = next(stream)  # ring is live and primed
+    assert shard_idx == 0
+    assert _ring_threads() - before, "ring workers should be running"
+    trainer.close()  # must reap WITHOUT consuming the stream
+    _wait_no_new_ring_threads(before)
+    # the generator was closed: resuming it is over immediately
+    assert list(stream) == []
+
+
+def test_epoch_end_reaps_ring_before_next_epoch(trainer):
+    """The normal path: after train_epoch returns, no ring threads
+    linger (the per-epoch executor is not left to the GC either)."""
+    before = _ring_threads()
+    stats = trainer.train_epoch()
+    assert stats["examples"] > 0
+    _wait_no_new_ring_threads(before)

@@ -27,6 +27,24 @@ PR-1/PR-2 invariant it guards):
 * XF005 C-ABI parity — ``XF*`` symbols in ``native/include/xflow_tpu.h``
   vs ``native/src/c_api.cc`` vs ``capi_impl.py``, no orphans.
 
+Concurrency rules (ISSUE 6; rules_concurrency.py) ride a package-wide
+call graph that classifies every function main-context / worker-context
+(reachable from ``Thread(target=...)``/executor ``submit``/``map``) /
+both:
+
+* XF006 thread lifecycle — started threads/executors need a bounded
+  (timeout) ``join``/``shutdown`` reachable from a close()/__exit__
+  path;
+* XF007 lock order — the package lock-acquisition graph must be
+  acyclic, and no untimed blocking call may run while holding a lock;
+  the runtime companion (analysis/sanitizer.py) cross-checks observed
+  acquisition orders against this graph;
+* XF008 shared-state discipline — state written outside ``__init__``
+  and touched from both thread contexts must be guarded at every
+  access;
+* XF009 heartbeat coverage — unbounded worker loops in hot-path
+  modules must pulse the flight-recorder heartbeat.
+
 Suppression: ``# xf: ignore[XF001]`` on the finding line, or
 ``# xf: ignore-file[XF001]`` anywhere in the file; a committed baseline
 file (``analysis-baseline.json``) grandfathers legacy findings without
@@ -49,6 +67,8 @@ from xflow_tpu.analysis.core import (
     run_analysis,
 )
 from xflow_tpu.analysis.report import render_json, render_text
+from xflow_tpu.analysis.rules_concurrency import static_lock_order
+from xflow_tpu.analysis.sanitizer import LockOrderSanitizer
 
 __all__ = [
     "Finding",
@@ -62,4 +82,6 @@ __all__ = [
     "split_baselined",
     "render_text",
     "render_json",
+    "static_lock_order",
+    "LockOrderSanitizer",
 ]

@@ -9,6 +9,7 @@ Examples:
     python -m xflow_tpu.analysis xflow_tpu/ --format json
     python -m xflow_tpu.analysis xflow_tpu/serve --select XF003
     python -m xflow_tpu.analysis xflow_tpu/ --write-baseline
+    python -m xflow_tpu.analysis xflow_tpu/ --changed-only   # pre-commit
 """
 
 from __future__ import annotations
@@ -25,6 +26,58 @@ from xflow_tpu.analysis.baseline import (
 )
 from xflow_tpu.analysis.core import all_rules, run_analysis
 from xflow_tpu.analysis.report import render_json, render_text
+
+
+def _git_changed_files() -> set[str] | None:
+    """Absolute paths of files changed vs HEAD (staged + unstaged)
+    plus untracked files, or None when not in a usable git work tree.
+    Runs git in the CURRENT directory — --changed-only is a pre-commit
+    convenience, invoked from the repo being committed."""
+    import subprocess
+
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True,
+            text=True,
+        )
+    except OSError:
+        return None
+    if top.returncode != 0:
+        return None
+    root = top.stdout.strip()
+    changed: set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        # run from the repo ROOT: ls-files prints paths relative to
+        # (and limited to) its cwd, so invoking the CLI from a subdir
+        # would otherwise mis-resolve — and silently drop — untracked
+        # files when joined against the root
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, cwd=root
+        )
+        if proc.returncode != 0:
+            return None  # e.g. a repo with no HEAD yet
+        changed.update(
+            os.path.abspath(os.path.join(root, line))
+            for line in proc.stdout.splitlines()
+            if line.strip()
+        )
+    return changed
+
+
+def _abspath_of(rel: str, paths: list[str]) -> str:
+    """Resolve a scan-relative finding/baseline path against the scan
+    roots."""
+    for p in paths:
+        p = os.path.abspath(p)
+        base = p if os.path.isdir(p) else os.path.dirname(p)
+        cand = os.path.join(base, rel)
+        if os.path.exists(cand):
+            return os.path.abspath(cand)
+    return os.path.abspath(rel)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -65,6 +118,17 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="list rule ids and exit"
     )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help=(
+            "report findings only for files changed vs git HEAD "
+            "(staged, unstaged, and untracked) — the fast pre-commit "
+            "mode.  The WHOLE tree is still scanned (cross-file rules "
+            "and the concurrency context need it); only the report is "
+            "scoped."
+        ),
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -83,6 +147,34 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    changed: set[str] | None = None
+    if args.changed_only:
+        if args.write_baseline:
+            print(
+                "error: --changed-only cannot be combined with "
+                "--write-baseline (regenerating the baseline needs the "
+                "FULL finding set — a scoped write would silently drop "
+                "every entry for unchanged files)",
+                file=sys.stderr,
+            )
+            return 2
+        changed = _git_changed_files()
+        if changed is None:
+            print(
+                "error: --changed-only requires a git work tree",
+                file=sys.stderr,
+            )
+            return 2
+        findings = [
+            f for f in findings
+            if _abspath_of(f.path, args.paths) in changed
+        ]
+        pragma_suppressed = [
+            f
+            for f in pragma_suppressed
+            if _abspath_of(f.path, args.paths) in changed
+        ]
+
     baseline_path = args.baseline
     if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
         baseline_path = DEFAULT_BASELINE
@@ -96,6 +188,15 @@ def main(argv: list[str] | None = None) -> int:
 
     entries = load_baseline(baseline_path)
     new, grandfathered, stale = split_baselined(findings, entries)
+    if changed is not None:
+        # scoped run: an entry for an UNCHANGED file has no findings to
+        # match only because they were filtered out above, not because
+        # it was fixed — staleness can only be judged for changed files
+        stale = [
+            e
+            for e in stale
+            if _abspath_of(e["path"], args.paths) in changed
+        ]
     render = render_json if args.format == "json" else render_text
     print(render(new, grandfathered, pragma_suppressed, stale))
     return 1 if new else 0

@@ -187,6 +187,12 @@ class Rule:
 
 def all_rules() -> list[Rule]:
     from xflow_tpu.analysis.rules_abi import CAbiParity
+    from xflow_tpu.analysis.rules_concurrency import (
+        HeartbeatCoverage,
+        LockOrder,
+        SharedStateDiscipline,
+        ThreadLifecycle,
+    )
     from xflow_tpu.analysis.rules_jax import HiddenHostSyncs, RecompileHazards
     from xflow_tpu.analysis.rules_schema import SchemaDrift
     from xflow_tpu.analysis.rules_threads import LockDiscipline
@@ -197,6 +203,10 @@ def all_rules() -> list[Rule]:
         LockDiscipline(),
         SchemaDrift(),
         CAbiParity(),
+        ThreadLifecycle(),
+        LockOrder(),
+        SharedStateDiscipline(),
+        HeartbeatCoverage(),
     ]
 
 

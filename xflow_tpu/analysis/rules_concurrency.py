@@ -1,0 +1,1035 @@
+"""XF006–XF009 — package-wide concurrency rules over a thread-context
+call graph.
+
+ROADMAP item 1 fans the input pipeline out to N shard-reader streams
+with per-stream compaction workers: more threads, more locks, more
+queues.  Every concurrency bug this repo has shipped so far (torn
+``MetricsLogger`` lines, the ``MicroBatcher`` close race, leaked
+``_PrefetchIter`` producer threads) was invisible to single-threaded
+tests and obvious in hindsight from the *code*.  These rules mechanize
+that hindsight before the fan-out multiplies the surface.
+
+The shared engine (``ConcurrencyContext``) extends XF002's intra-module
+call-graph closure into a package-wide one with thread-entrypoint
+tracking: every function is classified
+
+* **worker-context** — reachable (through resolvable calls) from a
+  ``threading.Thread(target=...)`` target or a
+  ``ThreadPoolExecutor.submit``/``.map`` submission;
+* **main-context** — reachable from a call-graph root (a function with
+  no resolvable in-package caller that is not itself a thread target);
+* or **both** (e.g. ``TrainStep.put_batch``: called inline on the
+  multi-host voting thread AND submitted to the transfer-ahead ring).
+
+Resolution is deliberately conservative: ``self.m()``, same-module
+``f()``, imported-module ``mod.f()``, and class instantiation resolve;
+arbitrary ``obj.m()`` calls do not (an unresolved callee simply stays a
+root, i.e. main-context).  Thread/submit *targets* are rare and
+explicit, so those additionally resolve ``self.x.m`` by unique method
+name across the package.
+
+Rules on top of the context graph:
+
+* **XF006 thread lifecycle** — every started thread / constructed
+  executor must have a reachable ``join``/``shutdown`` on a
+  ``close()``/``__exit__``/``stop()`` path, with a timeout (the
+  ``_PrefetchIter`` leak class, generalized);
+* **XF007 lock order** — the package-wide lock-acquisition graph
+  (nested ``with self._lock`` blocks, closed over calls) must be
+  acyclic, and no blocking call (``queue.get()``/``join()``/
+  ``.result()``/``.wait()`` without a timeout) may run while a lock is
+  held.  ``static_lock_order()`` exports this graph; the runtime
+  sanitizer (analysis/sanitizer.py) cross-checks observed acquisition
+  orders against it;
+* **XF008 shared-state discipline** — an attribute written outside
+  ``__init__`` and touched from both thread contexts must be guarded
+  at EVERY access (XF003 extended beyond lock-owning-class writes:
+  reads count, and the contexts come from the graph, not the class);
+* **XF009 heartbeat coverage** — unbounded loops in worker-context
+  functions inside hot-path modules must pulse the flight-recorder
+  heartbeat (``note_loader``/``note_serve``/``_pulse``…) so new
+  threads can never silently evade ``obs doctor``/the watchdog.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from xflow_tpu.analysis.core import (
+    Finding,
+    PackageIndex,
+    Rule,
+    SourceFile,
+    dotted_name,
+    walk_scoped,
+)
+from xflow_tpu.analysis.rules_threads import _lock_ctor, _self_attr
+
+_CONSTRUCTOR_METHODS = ("__init__", "__new__")
+
+# method names that form a shutdown path: a join/shutdown reachable
+# from one of these (via same-class self-calls) satisfies XF006
+_CLOSER_METHODS = {
+    "close", "stop", "shutdown", "join", "terminate", "__exit__", "__del__",
+}
+
+# the flight-recorder/watchdog heartbeat surface (obs/flight.py,
+# trainer._pulse): a worker loop pulsing any of these is observable
+_HEARTBEAT_CALLS = {
+    "note_loader", "note_serve", "note_phase", "note_batch", "_pulse",
+}
+
+# attribute types that ARE the hand-off discipline: mutating through
+# them is thread-safe by construction, so XF008 exempts the attribute
+_THREADSAFE_CTORS = {
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+    "Event", "Lock", "RLock", "Condition",
+    "Semaphore", "BoundedSemaphore", "Barrier", "deque",
+}
+
+# modules whose worker silence the watchdog must be able to classify
+_HOT_PATH_PREFIXES = ("io/", "serve/", "obs/", "parallel/")
+_HOT_PATH_FILES = ("trainer.py",)
+
+
+def _is_hot_path(rel: str) -> bool:
+    if rel in _HOT_PATH_FILES or any(
+        rel.endswith("/" + f) for f in _HOT_PATH_FILES
+    ):
+        return True
+    return any(
+        rel.startswith(p) or ("/" + p) in rel for p in _HOT_PATH_PREFIXES
+    )
+
+
+def _leaf(name: str | None) -> str | None:
+    return None if name is None else name.rsplit(".", 1)[-1]
+
+
+def _call_leaf(node: ast.Call) -> str | None:
+    """Trailing attribute/name of the called expression ('submit' for
+    ``ex.submit(...)`` even when ``ex`` isn't a plain dotted path)."""
+    name = dotted_name(node.func)
+    if name is not None:
+        return _leaf(name)
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def _timeout_arg(call: ast.Call) -> bool:
+    return bool(call.args) or any(
+        kw.arg == "timeout" for kw in call.keywords
+    )
+
+
+def _thread_join_call(node: ast.AST) -> ast.Call | None:
+    """``node`` when it is plausibly a THREAD's join: ``x.join(...)``
+    where the receiver is a name or attribute chain.  ``', '.join(
+    parts)`` (a string-literal receiver) must not satisfy the XF006
+    shutdown-join requirement — the classic false pass."""
+    if not (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "join"
+    ):
+        return None
+    recv = node.func.value
+    if isinstance(recv, (ast.Name, ast.Attribute)):
+        return node
+    return None
+
+
+@dataclass
+class _Fn:
+    """One function/method in the package-wide graph."""
+
+    sf: SourceFile
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    cls: str | None
+    parent: "_Fn | None"
+    children: dict[str, "_Fn"] = field(default_factory=dict)
+    calls: list["_Fn"] = field(default_factory=list)
+    called: bool = False  # has a resolved in-package plain caller
+    is_worker: bool = False
+    is_main: bool = False
+    worker_seed_site: str = ""  # how it became a thread entrypoint
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+class ConcurrencyContext:
+    """Package-wide call graph + thread-context classification, built
+    once per ``PackageIndex`` and shared by XF006–XF009 (cached on the
+    index so the four rules don't re-derive it)."""
+
+    def __init__(self, index: PackageIndex):
+        self.index = index
+        self.fns: list[_Fn] = []
+        self.module_fns: dict[tuple[str, str], _Fn] = {}
+        self.methods: dict[tuple[str, str, str], _Fn] = {}
+        self.methods_by_name: dict[str, list[_Fn]] = {}
+        self.classes: dict[tuple[str, str], ast.ClassDef] = {}
+        self.class_methods: dict[tuple[str, str], list[_Fn]] = {}
+        self.class_locks: dict[tuple[str, str], dict[str, str]] = {}
+        self.module_locks: dict[tuple[str, str], str] = {}
+        self.imports: dict[str, dict[str, str]] = {}  # rel -> alias -> module
+        for sf in index.files:
+            if sf.tree is not None:
+                self._collect_file(sf)
+        self._resolve_calls()
+        self._classify()
+
+    # -- collection --------------------------------------------------------
+
+    def _collect_file(self, sf: SourceFile) -> None:
+        imports: dict[str, str] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        imports[alias.asname] = alias.name
+                    elif "." not in alias.name:
+                        imports[alias.name] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    imports[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+        self.imports[sf.rel] = imports
+
+        def visit(node: ast.AST, cls: str | None, parent: _Fn | None):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    fn = _Fn(sf, child, cls, parent)
+                    self.fns.append(fn)
+                    if parent is not None:
+                        parent.children[child.name] = fn
+                    elif cls is not None:
+                        self.methods[(sf.rel, cls, child.name)] = fn
+                        self.class_methods.setdefault(
+                            (sf.rel, cls), []
+                        ).append(fn)
+                        self.methods_by_name.setdefault(
+                            child.name, []
+                        ).append(fn)
+                    else:
+                        self.module_fns[(sf.rel, child.name)] = fn
+                    visit(child, cls, fn)
+                elif isinstance(child, ast.ClassDef):
+                    self.classes[(sf.rel, child.name)] = child
+                    self.class_methods.setdefault((sf.rel, child.name), [])
+                    self._collect_class_locks(sf, child)
+                    visit(child, child.name, None)
+                else:
+                    if cls is None and parent is None:
+                        self._collect_module_lock(sf, child)
+                    visit(child, cls, parent)
+
+        visit(sf.tree, None, None)
+
+    def _collect_class_locks(self, sf: SourceFile, cls: ast.ClassDef) -> None:
+        locks: dict[str, str] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _lock_ctor(node.value):
+                kind = _leaf(dotted_name(node.value.func)) or "Lock"
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None:
+                        locks[attr] = kind
+        if locks:
+            self.class_locks[(sf.rel, cls.name)] = locks
+
+    def _collect_module_lock(self, sf: SourceFile, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign) and _lock_ctor(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.module_locks[(sf.rel, tgt.id)] = (
+                        _leaf(dotted_name(node.value.func)) or "Lock"
+                    )
+
+    # -- resolution --------------------------------------------------------
+
+    def _module_file(self, rel: str, modpath: str) -> str | None:
+        """Scan-relative file for a dotted module path, by suffix."""
+        parts = modpath.split(".")
+        for i in range(len(parts)):
+            cand = "/".join(parts[i:]) + ".py"
+            sf = self.index.by_rel(cand)
+            if sf is not None:
+                return sf.rel
+        return None
+
+    def _resolve_name(self, fn: _Fn | None, rel: str, name: str) -> _Fn | None:
+        """A bare-name callee: nested defs up the enclosing chain, then
+        module functions, then imported symbols, then classes (their
+        ``__init__``)."""
+        scope = fn
+        while scope is not None:
+            if name in scope.children:
+                return scope.children[name]
+            scope = scope.parent
+        if (rel, name) in self.module_fns:
+            return self.module_fns[(rel, name)]
+        if (rel, name) in self.classes:
+            return self.methods.get((rel, name, "__init__"))
+        target = self.imports.get(rel, {}).get(name)
+        if target is not None:
+            mod, _, leafname = target.rpartition(".")
+            mrel = self._module_file(rel, mod) if mod else None
+            if mrel is not None:
+                if (mrel, leafname) in self.module_fns:
+                    return self.module_fns[(mrel, leafname)]
+                if (mrel, leafname) in self.classes:
+                    return self.methods.get((mrel, leafname, "__init__"))
+        return None
+
+    def _resolve_call(self, fn: _Fn | None, sf: SourceFile,
+                      call: ast.Call) -> _Fn | None:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self._resolve_name(fn, sf.rel, func.id)
+        if isinstance(func, ast.Attribute):
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and fn is not None
+                and fn.cls is not None
+            ):
+                return self.methods.get((sf.rel, fn.cls, func.attr))
+            name = dotted_name(func)
+            if name is not None and "." in name:
+                head, _, leafname = name.rpartition(".")
+                modpath = self.imports.get(sf.rel, {}).get(
+                    head.split(".", 1)[0]
+                )
+                if modpath is not None:
+                    full = head.replace(head.split(".", 1)[0], modpath, 1)
+                    mrel = self._module_file(sf.rel, full)
+                    if mrel is not None:
+                        return self.module_fns.get((mrel, leafname))
+        return None
+
+    def _resolve_target_ref(self, fn: _Fn | None, sf: SourceFile,
+                            ref: ast.AST) -> list[_Fn]:
+        """A function REFERENCE (thread target / submit arg).  Unlike
+        plain calls, ``self.x.m`` resolves fuzzily by method name — the
+        submission site is explicit and rare, so over-approximating
+        worker context there is the safe direction."""
+        if isinstance(ref, ast.Name):
+            got = self._resolve_name(fn, sf.rel, ref.id)
+            return [got] if got is not None else []
+        if isinstance(ref, ast.Attribute):
+            if (
+                isinstance(ref.value, ast.Name)
+                and ref.value.id == "self"
+                and fn is not None
+                and fn.cls is not None
+            ):
+                got = self.methods.get((sf.rel, fn.cls, ref.attr))
+                if got is not None:
+                    return [got]
+            return list(self.methods_by_name.get(ref.attr, []))
+        return []
+
+    def _resolve_calls(self) -> None:
+        worker_seeds: list[tuple[_Fn, str]] = []
+
+        attr_called: set[str] = set()
+
+        def scan_calls(owner: _Fn | None, sf: SourceFile, root: ast.AST):
+            for node in walk_scoped(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self._resolve_call(owner, sf, node)
+                if callee is not None and owner is not None:
+                    owner.calls.append(callee)
+                    callee.called = True
+                elif callee is not None:
+                    callee.called = True  # module-level call
+                elif isinstance(node.func, ast.Attribute):
+                    # unresolved obj.m(...) — evidence that a method
+                    # named m has a plain (main-context) caller even
+                    # when the receiver can't be typed statically
+                    attr_called.add(node.func.attr)
+                leaf = _call_leaf(node)
+                targets: list[ast.AST] = []
+                if leaf == "Thread":
+                    targets = [
+                        kw.value for kw in node.keywords
+                        if kw.arg == "target"
+                    ]
+                elif leaf == "submit" and isinstance(
+                    node.func, ast.Attribute
+                ) and node.args:
+                    targets = [node.args[0]]
+                elif leaf == "map" and isinstance(
+                    node.func, ast.Attribute
+                ) and node.args:
+                    targets = [node.args[0]]
+                for ref in targets:
+                    for t in self._resolve_target_ref(owner, sf, ref):
+                        site = f"{sf.rel}:{node.lineno}"
+                        worker_seeds.append((t, site))
+
+        for fn in self.fns:
+            scan_calls(fn, fn.sf, fn.node)
+        for sf in self.index.files:
+            if sf.tree is None:
+                continue
+            # module-level statements (outside any def)
+            scan_calls(None, sf, sf.tree)
+        self._worker_seeds = worker_seeds
+        self._attr_called = attr_called
+
+    # -- classification ----------------------------------------------------
+
+    def _classify(self) -> None:
+        seeded: set[int] = set()
+        stack: list[_Fn] = []
+        for fn, site in self._worker_seeds:
+            if id(fn) not in seeded:
+                seeded.add(id(fn))
+                fn.worker_seed_site = site
+                stack.append(fn)
+        worker: set[int] = set(seeded)
+        while stack:
+            fn = stack.pop()
+            fn.is_worker = True
+            for callee in fn.calls:
+                if id(callee) not in worker:
+                    worker.add(id(callee))
+                    stack.append(callee)
+        # main roots: no resolved in-package caller and not exclusively
+        # a thread entrypoint (an unresolved call site keeps its callee
+        # a root — conservative toward main).  A seeded entrypoint that
+        # ALSO has an unresolved obj.m() caller by its name (TrainStep.
+        # put_batch: submitted to the ring AND called inline) is both.
+        stack = [
+            fn for fn in self.fns
+            if not fn.called
+            and (id(fn) not in seeded or fn.name in self._attr_called)
+        ]
+        main: set[int] = {id(fn) for fn in stack}
+        while stack:
+            fn = stack.pop()
+            fn.is_main = True
+            for callee in fn.calls:
+                if id(callee) not in main:
+                    main.add(id(callee))
+                    stack.append(callee)
+
+    # -- shared lock machinery (XF007 + sanitizer export) ------------------
+
+    def lock_node(self, fn: _Fn | None, sf: SourceFile,
+                  expr: ast.AST) -> str | None:
+        """The lock-graph node acquired by a ``with <expr>`` item, or
+        None when the expression isn't a known lock."""
+        attr = _self_attr(expr)
+        if attr is not None and fn is not None and fn.cls is not None:
+            if attr in self.class_locks.get((sf.rel, fn.cls), {}):
+                return f"{fn.cls}.{attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if (sf.rel, expr.id) in self.module_locks:
+                return f"{sf.rel}:{expr.id}"
+        return None
+
+    def lock_kind(self, node: str) -> str:
+        if ":" in node:
+            rel, name = node.split(":", 1)
+            return self.module_locks.get((rel, name), "Lock")
+        cls, _, attr = node.rpartition(".")
+        for (rel, c), locks in self.class_locks.items():
+            if c == cls and attr in locks:
+                return locks[attr]
+        return "Lock"
+
+
+def get_context(index: PackageIndex) -> ConcurrencyContext:
+    ctx = getattr(index, "_concurrency_ctx", None)
+    if ctx is None:
+        ctx = ConcurrencyContext(index)
+        index._concurrency_ctx = ctx
+    return ctx
+
+
+# -- XF006 ----------------------------------------------------------------
+
+
+class ThreadLifecycle(Rule):
+    id = "XF006"
+    title = "thread/executor without a bounded shutdown path"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        ctx = get_context(index)
+        for (rel, cls), cls_node in ctx.classes.items():
+            sf = index.by_rel(rel)
+            if sf is not None:
+                yield from self._check_class(ctx, sf, rel, cls, cls_node)
+        for fn in ctx.fns:
+            yield from self._check_locals(fn)
+
+    # -- class-owned threads/executors ------------------------------------
+
+    def _closer_reachable(self, ctx: ConcurrencyContext, rel: str,
+                          cls: str) -> list[_Fn]:
+        """Methods reachable (same-class self-calls) from a shutdown-
+        path method — where the join/shutdown must live."""
+        methods = ctx.class_methods.get((rel, cls), [])
+        reach = [m for m in methods if m.name in _CLOSER_METHODS]
+        seen = {id(m) for m in reach}
+        stack = list(reach)
+        while stack:
+            m = stack.pop()
+            for callee in m.calls:
+                if callee.cls == cls and id(callee) not in seen:
+                    seen.add(id(callee))
+                    reach.append(callee)
+                    stack.append(callee)
+        return reach
+
+    def _check_class(self, ctx: ConcurrencyContext, sf: SourceFile,
+                     rel: str, cls: str,
+                     cls_node: ast.ClassDef) -> Iterator[Finding]:
+        thread_attrs: dict[str, ast.Call] = {}
+        exec_attrs: dict[str, ast.Call] = {}
+        started: set[str] = set()
+        for node in ast.walk(cls_node):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                leaf = _call_leaf(node.value)
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    if leaf == "Thread":
+                        thread_attrs[attr] = node.value
+                    elif leaf is not None and leaf.endswith("PoolExecutor"):
+                        exec_attrs[attr] = node.value
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and node.func.attr == "start":
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    started.add(attr)
+        if not thread_attrs and not exec_attrs:
+            return
+        closers = self._closer_reachable(ctx, rel, cls)
+        joins: list[ast.Call] = []
+        shutdowns: list[ast.Call] = []
+        for m in closers:
+            for node in walk_scoped(m.node):
+                join = _thread_join_call(node)
+                if join is not None:
+                    joins.append(join)
+                elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ) and node.func.attr == "shutdown":
+                    shutdowns.append(node)
+        for attr, ctor in thread_attrs.items():
+            if attr not in started:
+                continue
+            if not joins:
+                yield self.finding(
+                    sf, ctor,
+                    f"thread self.{attr} of {cls} is started but no "
+                    "join() is reachable from a close()/__exit__/stop() "
+                    "method — an abandoned consumer leaks the thread "
+                    "(the _PrefetchIter leak class); join it with a "
+                    "timeout on the shutdown path",
+                )
+            elif not any(_timeout_arg(j) for j in joins):
+                yield self.finding(
+                    sf, ctor,
+                    f"thread self.{attr} of {cls} is joined without a "
+                    "timeout on its shutdown path — a wedged worker "
+                    "blocks close() forever; use join(timeout=...) and "
+                    "surface is_alive() leaks",
+                )
+        for attr, ctor in exec_attrs.items():
+            if not shutdowns:
+                yield self.finding(
+                    sf, ctor,
+                    f"executor self.{attr} of {cls} has no shutdown() "
+                    "reachable from a close()/__exit__/stop() method — "
+                    "its worker threads outlive the owner; call "
+                    "shutdown() on the shutdown path (or use `with`)",
+                )
+
+    # -- function-local threads/executors ----------------------------------
+
+    def _check_locals(self, fn: _Fn) -> Iterator[Finding]:
+        with_items: set[int] = set()
+        self_assigned: set[int] = set()
+        local_threads: list[ast.Call] = []
+        local_execs: list[ast.Call] = []
+        for node in walk_scoped(fn.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        with_items.add(id(sub))
+            if isinstance(node, ast.Assign):
+                to_self = any(
+                    _self_attr(t) is not None for t in node.targets
+                )
+                if to_self:
+                    for sub in ast.walk(node.value):
+                        self_assigned.add(id(sub))
+        for node in walk_scoped(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _call_leaf(node)
+            if id(node) in self_assigned or id(node) in with_items:
+                continue
+            if leaf == "Thread":
+                local_threads.append(node)
+            elif leaf is not None and leaf.endswith("PoolExecutor"):
+                local_execs.append(node)
+        if not local_threads and not local_execs:
+            return
+        joins = [
+            join for node in walk_scoped(fn.node)
+            if (join := _thread_join_call(node)) is not None
+        ]
+        shutdowns = [
+            node for node in walk_scoped(fn.node)
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "shutdown"
+        ]
+        for ctor in local_threads:
+            if not joins:
+                yield self.finding(
+                    fn.sf, ctor,
+                    f"thread created in {fn.qualname}() is never "
+                    "joined in the function — fire-and-forget threads "
+                    "outlive their work and evade shutdown; join with "
+                    "a timeout (or own it on self with a close() path)",
+                )
+            elif not any(_timeout_arg(j) for j in joins):
+                yield self.finding(
+                    fn.sf, ctor,
+                    f"thread created in {fn.qualname}() is joined "
+                    "without a timeout — a wedged worker hangs the "
+                    "caller forever; use join(timeout=...)",
+                )
+        for ctor in local_execs:
+            if not shutdowns:
+                yield self.finding(
+                    fn.sf, ctor,
+                    f"executor created in {fn.qualname}() without "
+                    "`with` or a shutdown() call — worker threads "
+                    "leak past the function; use a `with` block",
+                )
+
+
+# -- XF007 ----------------------------------------------------------------
+
+_BLOCKING_ATTRS = ("join", "result", "wait", "get")
+
+
+class LockOrder(Rule):
+    id = "XF007"
+    title = "lock-order cycle / blocking call under a lock"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        ctx = get_context(index)
+        edges, sites, blocking = _lock_analysis(ctx)
+        yield from (
+            self.finding(sf, node, msg) for sf, node, msg in blocking
+        )
+        for cycle in _find_cycles(edges):
+            a = cycle[0]
+            nxt = cycle[1] if len(cycle) > 1 else a
+            sf, node = sites[(a, nxt)]
+            path = " -> ".join(cycle + (a,))
+            if len(cycle) == 1:
+                kind = ctx.lock_kind(a)
+                if kind == "RLock":
+                    continue  # reentrant: self-nesting is legal
+                yield self.finding(
+                    sf, node,
+                    f"lock {a} is re-acquired while already held "
+                    "(non-reentrant Lock) — self-deadlock; use RLock "
+                    "or restructure",
+                )
+            else:
+                yield self.finding(
+                    sf, node,
+                    f"lock-order cycle {path} — two threads taking "
+                    "these locks in opposite orders deadlock; impose "
+                    "one global order (docs/ANALYSIS.md XF007)",
+                )
+
+
+def _lock_analysis(ctx: ConcurrencyContext):
+    """(edges, edge_sites, blocking_findings) over the whole package.
+
+    Edges are lexical nestings of known-lock ``with`` blocks plus, for
+    calls made while holding a lock, every lock the callee's transitive
+    closure acquires.
+    """
+    direct: dict[int, set[str]] = {}
+    calls_held: list[tuple[str, _Fn, SourceFile, ast.AST]] = []
+    edges: dict[str, set[str]] = {}
+    sites: dict[tuple[str, str], tuple[SourceFile, ast.AST]] = {}
+    blocking: list[tuple[SourceFile, ast.AST, str]] = []
+
+    def add_edge(a: str, b: str, sf: SourceFile, node: ast.AST) -> None:
+        edges.setdefault(a, set()).add(b)
+        sites.setdefault((a, b), (sf, node))
+
+    def scan(fn: _Fn) -> None:
+        acquired: set[str] = set()
+
+        def visit(node: ast.AST, held: tuple[str, ...]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef,
+                     ast.ClassDef, ast.Lambda),
+                ):
+                    continue
+                child_held = held
+                if isinstance(child, ast.With):
+                    # items acquire LEFT TO RIGHT: in `with a, b:` the
+                    # edge a->b comes from the accumulating held set,
+                    # not the outer one
+                    for item in child.items:
+                        lock = ctx.lock_node(fn, fn.sf, item.context_expr)
+                        if lock is None:
+                            continue
+                        acquired.add(lock)
+                        for h in child_held:
+                            add_edge(h, lock, fn.sf, child)
+                        child_held = child_held + (lock,)
+                if isinstance(child, ast.Call) and held:
+                    callee = ctx._resolve_call(fn, fn.sf, child)
+                    if callee is not None:
+                        calls_held.append(
+                            (held[-1], callee, fn.sf, child)
+                        )
+                    leaf = (
+                        child.func.attr
+                        if isinstance(child.func, ast.Attribute)
+                        else None
+                    )
+                    if leaf in _BLOCKING_ATTRS:
+                        is_blocking = (
+                            leaf != "get"
+                            and not _timeout_arg(child)
+                        ) or (
+                            leaf == "get"
+                            and not child.args
+                            and not any(
+                                kw.arg == "timeout"
+                                for kw in child.keywords
+                            )
+                        )
+                        # dict.get(k)/deque ops pass args; a bare
+                        # .get() is the blocking queue idiom
+                        if is_blocking:
+                            blocking.append((
+                                fn.sf, child,
+                                f".{leaf}() without a timeout while "
+                                f"holding {held[-1]} — a blocked "
+                                "holder stalls every other thread at "
+                                "the lock; add a timeout or move the "
+                                "wait outside the critical section",
+                            ))
+                visit(child, child_held)
+
+        visit(fn.node, ())
+        direct[id(fn)] = acquired
+
+    for fn in ctx.fns:
+        scan(fn)
+
+    # transitive acquisition closure per function
+    closure: dict[int, set[str]] = {
+        id(fn): set(direct.get(id(fn), ())) for fn in ctx.fns
+    }
+    changed = True
+    while changed:
+        changed = False
+        for fn in ctx.fns:
+            mine = closure[id(fn)]
+            before = len(mine)
+            for callee in fn.calls:
+                mine |= closure.get(id(callee), set())
+            if len(mine) != before:
+                changed = True
+    for held, callee, sf, node in calls_held:
+        for lock in closure.get(id(callee), ()):  # interprocedural edge
+            add_edge(held, lock, sf, node)
+    return edges, sites, blocking
+
+
+def _find_cycles(edges: dict[str, set[str]]) -> list[tuple[str, ...]]:
+    """Elementary cycles.  Every cycle is discovered from its smallest
+    node only (the ``nxt > start`` prune), so each PATH is already the
+    cycle's canonical rotation — deduping by path keeps two
+    opposite-direction cycles over the same node set distinct (A->B->C
+    and A->C->B are different deadlocks)."""
+    cycles: list[tuple[str, ...]] = []
+    seen: set[tuple[str, ...]] = set()
+    for start in sorted(edges):
+        stack: list[tuple[str, tuple[str, ...]]] = [(start, (start,))]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(edges.get(node, ())):
+                if nxt == start:
+                    if path not in seen:
+                        seen.add(path)
+                        cycles.append(path)
+                elif nxt not in path and nxt > start:
+                    # only explore nodes > start: each cycle is found
+                    # from its smallest node exactly once
+                    stack.append((nxt, path + (nxt,)))
+    return cycles
+
+
+def static_lock_order(
+    paths: list[str] | PackageIndex,
+) -> dict[str, list[str]]:
+    """The static XF007 lock-acquisition graph as plain JSON-able data
+    — the contract the runtime sanitizer (analysis/sanitizer.py)
+    cross-checks observed acquisition orders against."""
+    index = (
+        paths if isinstance(paths, PackageIndex) else PackageIndex(paths)
+    )
+    edges, _, _ = _lock_analysis(get_context(index))
+    return {a: sorted(bs) for a, bs in sorted(edges.items())}
+
+
+# -- XF008 ----------------------------------------------------------------
+
+
+@dataclass
+class _Access:
+    attr: str
+    fn: _Fn
+    guarded: bool
+    is_write: bool
+    node: ast.AST
+
+
+class SharedStateDiscipline(Rule):
+    id = "XF008"
+    title = "cross-thread-context state without a guard"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        ctx = get_context(index)
+        for (rel, cls) in ctx.classes:
+            sf = index.by_rel(rel)
+            if sf is not None:
+                yield from self._check_class(ctx, sf, rel, cls)
+
+    def _check_class(self, ctx: ConcurrencyContext, sf: SourceFile,
+                     rel: str, cls: str) -> Iterator[Finding]:
+        methods = ctx.class_methods.get((rel, cls), [])
+        if not methods:
+            return
+        locks = set(ctx.class_locks.get((rel, cls), ()))
+        method_names = {m.name for m in methods}
+        primitives: set[str] = set()
+        cls_node = ctx.classes[(rel, cls)]
+        for node in ast.walk(cls_node):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                leaf = _call_leaf(node.value)
+                if leaf in _THREADSAFE_CTORS:
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            primitives.add(attr)
+        accesses: list[_Access] = []
+        for m in methods:
+            self._collect(ctx, m, locks, accesses)
+            for nested in self._nested(m):
+                self._collect(ctx, nested, locks, accesses)
+        by_attr: dict[str, list[_Access]] = {}
+        for a in accesses:
+            if a.attr in locks or a.attr in primitives:
+                continue
+            if a.attr in method_names:
+                continue  # bound-method references, not state
+            by_attr.setdefault(a.attr, []).append(a)
+        for attr, sites in sorted(by_attr.items()):
+            outside = [
+                s for s in sites
+                if s.fn.name not in _CONSTRUCTOR_METHODS
+            ]
+            if not any(s.is_write for s in outside):
+                continue  # init-then-read-only: publication, not a race
+            worker = [s for s in outside if s.fn.is_worker]
+            main = [
+                s for s in outside
+                if s.fn.is_main or not s.fn.is_worker
+            ]
+            if not worker or not main:
+                continue  # single-context state
+            for s in outside:
+                if s.guarded:
+                    continue
+                kind = "written" if s.is_write else "read"
+                wm = sorted({x.fn.name for x in worker})[0]
+                mm = sorted({x.fn.name for x in main})[0]
+                yield self.finding(
+                    sf, s.node,
+                    f"self.{attr} of {cls} crosses thread contexts "
+                    f"(worker-context {wm}(), main-context {mm}()) but "
+                    f"is {kind} in {s.fn.name}() without a lock — "
+                    "guard every access or hand off via a "
+                    "queue/Event (XF008, docs/ANALYSIS.md)",
+                )
+
+    @staticmethod
+    def _nested(fn: _Fn) -> list[_Fn]:
+        out: list[_Fn] = []
+        stack = list(fn.children.values())
+        while stack:
+            f = stack.pop()
+            out.append(f)
+            stack.extend(f.children.values())
+        return out
+
+    def _collect(self, ctx: ConcurrencyContext, fn: _Fn,
+                 locks: set[str], out: list[_Access]) -> None:
+        def lock_item(item: ast.withitem) -> bool:
+            return _self_attr(item.context_expr) in locks
+
+        def visit(node: ast.AST, guarded: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef,
+                     ast.ClassDef, ast.Lambda),
+                ):
+                    continue
+                child_guarded = guarded
+                if isinstance(child, ast.With):
+                    child_guarded = guarded or any(
+                        lock_item(i) for i in child.items
+                    )
+                if isinstance(child, ast.Subscript) and isinstance(
+                    child.ctx, ast.Store
+                ):
+                    attr = _self_attr(child)
+                    if attr is not None:
+                        # self.x[k] = v: ONE write to x (the inner
+                        # self.x Load must not double as a read site)
+                        out.append(_Access(
+                            attr, fn, child_guarded, True, child
+                        ))
+                        visit(child.slice, child_guarded)
+                        continue
+                if isinstance(child, ast.Attribute) and isinstance(
+                    child.value, ast.Name
+                ) and child.value.id == "self":
+                    if isinstance(child.ctx, ast.Store):
+                        out.append(_Access(
+                            child.attr, fn, child_guarded, True, child
+                        ))
+                    elif isinstance(child.ctx, ast.Load):
+                        out.append(_Access(
+                            child.attr, fn, child_guarded, False, child
+                        ))
+                visit(child, child_guarded)
+
+        visit(fn.node, False)
+
+
+# -- XF009 ----------------------------------------------------------------
+
+
+class HeartbeatCoverage(Rule):
+    id = "XF009"
+    title = "worker loop without a watchdog heartbeat"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        ctx = get_context(index)
+        has_beat = self._heartbeat_closure(ctx)
+        for fn in ctx.fns:
+            if not fn.is_worker or not _is_hot_path(fn.sf.rel):
+                continue
+            for node in walk_scoped(fn.node):
+                if isinstance(node, ast.While) and _unbounded(node.test):
+                    if not self._loop_beats(ctx, fn, node, has_beat):
+                        yield self.finding(
+                            fn.sf, node,
+                            f"unbounded loop in worker-context "
+                            f"{fn.qualname}() (hot-path module) never "
+                            "pulses the flight-recorder heartbeat — "
+                            "its silence is invisible to the watchdog "
+                            "and `obs doctor`; call note_loader/"
+                            "note_serve/_pulse each iteration "
+                            "(docs/OBSERVABILITY.md) or pragma with "
+                            "a justification",
+                        )
+
+    @staticmethod
+    def _heartbeat_closure(ctx: ConcurrencyContext) -> set[int]:
+        direct: set[int] = set()
+        for fn in ctx.fns:
+            for node in walk_scoped(fn.node):
+                if isinstance(node, ast.Call) and _call_leaf(
+                    node
+                ) in _HEARTBEAT_CALLS:
+                    direct.add(id(fn))
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for fn in ctx.fns:
+                if id(fn) in direct:
+                    continue
+                if any(id(c) in direct for c in fn.calls):
+                    direct.add(id(fn))
+                    changed = True
+        return direct
+
+    def _loop_beats(self, ctx: ConcurrencyContext, fn: _Fn,
+                    loop: ast.While, has_beat: set[int]) -> bool:
+        # pruned walk (walk_scoped semantics): a heartbeat inside a
+        # nested def/lambda the loop merely DEFINES is not a beat —
+        # only calls the loop body actually executes count
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef,
+                 ast.Lambda, ast.ClassDef),
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                if _call_leaf(node) in _HEARTBEAT_CALLS:
+                    return True
+                callee = ctx._resolve_call(fn, fn.sf, node)
+                if callee is not None and id(callee) in has_beat:
+                    return True
+            stack.extend(ast.iter_child_nodes(node))
+        return False
+
+
+def _unbounded(test: ast.AST) -> bool:
+    """A loop condition with no comparison is treated as unbounded:
+    ``while True``, ``while not stopping``, ``while not
+    stop.is_set()``.  Counting loops (``while n < limit``) compare."""
+    if isinstance(test, ast.Constant):
+        return bool(test.value)
+    return not any(isinstance(n, ast.Compare) for n in ast.walk(test))

@@ -1,0 +1,192 @@
+"""Runtime lock-order sanitizer — XF007's runtime companion.
+
+The static rule (rules_concurrency.LockOrder) proves the lock graph
+acyclic for the acquisition orders it can SEE: lexical ``with`` nesting
+plus resolvable calls.  Acquisitions it cannot see — callbacks, locks
+reached through untyped references, ``acquire()`` calls — only show up
+at runtime.  This module closes that gap: an instrumented lock wrapper
+records every *actual* nested acquisition during the tier-1 lock-stress
+tests, and the observed edges are cross-checked against the static
+XF007 graph (``rules_concurrency.static_lock_order``).  A cycle in the
+combined graph that the static pass alone doesn't have is a
+**contradiction**: real executions take those locks in an order the
+static model says (or would say, once both orders ship) can deadlock.
+
+Opt-in and zero-overhead when off:
+
+* ``maybe_instrument(obj, attr)`` is a no-op returning ``None`` unless
+  armed — the object keeps its plain ``threading.Lock``, no wrapper is
+  even allocated;
+* armed via the ``XFLOW_LOCK_SANITIZER`` env var, ``Config.
+  obs_lock_sanitizer`` (the Trainer instruments its obs-stack locks —
+  MetricsLogger/FlightRecorder/Watchdog/MetricsRegistry), or
+  explicitly by constructing a ``LockOrderSanitizer`` and calling
+  ``instrument`` (what the lock-stress tests do);
+* when armed, the cost per acquisition is one thread-local list
+  append plus — only while another lock is already held — a dict
+  insert under the sanitizer's own (internal, never-nested) lock.
+
+Naming: instrumented locks default to ``ClassName.attr``, matching the
+static graph's node names, so observed and static edges join without a
+translation table.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Iterable, Mapping
+
+from xflow_tpu.analysis.rules_concurrency import _find_cycles
+
+ENV_FLAG = "XFLOW_LOCK_SANITIZER"
+
+
+def armed(environ: Mapping[str, str] = os.environ) -> bool:
+    """Is the sanitizer requested by the environment?"""
+    return environ.get(ENV_FLAG, "") not in ("", "0", "false", "off")
+
+
+class _InstrumentedLock:
+    """A ``threading.Lock``/``RLock`` proxy that reports acquisition
+    order to its sanitizer.  Context-manager and acquire/release
+    compatible; the wrapped lock does the real blocking."""
+
+    __slots__ = ("_lock", "name", "_san")
+
+    def __init__(self, lock: Any, name: str, san: "LockOrderSanitizer"):
+        self._lock = lock
+        self.name = name
+        self._san = san
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            # record AFTER acquiring: the edge is the order that
+            # actually happened, not the order that was attempted
+            self._san._acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._san._released(self.name)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+
+class LockOrderSanitizer:
+    """Records (held -> acquired) edges across every instrumented lock
+    and cross-checks them against the static XF007 graph."""
+
+    def __init__(self) -> None:
+        self._meta = threading.Lock()  # guards _edges; never nested
+        self._tls = threading.local()
+        self._edges: dict[str, set[str]] = {}
+
+    # -- instrumentation ----------------------------------------------------
+
+    def wrap(self, lock: Any, name: str) -> _InstrumentedLock:
+        return _InstrumentedLock(lock, name, self)
+
+    def instrument(
+        self, obj: Any, attr: str, name: str | None = None
+    ) -> _InstrumentedLock:
+        """Swap ``obj.<attr>`` for an instrumented wrapper (idempotent).
+        The default name ``ClassName.attr`` matches the static graph's
+        node naming."""
+        current = getattr(obj, attr)
+        if isinstance(current, _InstrumentedLock):
+            return current
+        wrapper = self.wrap(
+            current, name or f"{type(obj).__name__}.{attr}"
+        )
+        setattr(obj, attr, wrapper)
+        return wrapper
+
+    # -- recording ----------------------------------------------------------
+
+    def _stack(self) -> list[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _acquired(self, name: str) -> None:
+        stack = self._stack()
+        if stack:
+            with self._meta:
+                for held in stack:
+                    if held != name:  # RLock re-entry is not an edge
+                        self._edges.setdefault(held, set()).add(name)
+        stack.append(name)
+
+    def _released(self, name: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                break
+
+    # -- reporting ----------------------------------------------------------
+
+    def edges(self) -> dict[str, set[str]]:
+        """Observed (held -> acquired) pairs so far."""
+        with self._meta:
+            return {a: set(bs) for a, bs in self._edges.items()}
+
+    def reset(self) -> None:
+        with self._meta:
+            self._edges.clear()
+
+    def contradictions(
+        self, static_edges: Mapping[str, Iterable[str]]
+    ) -> list[str]:
+        """Cycles in (static ∪ observed) that the static graph alone
+        does not contain — i.e. real executions acquired locks in an
+        order that, combined with the statically-proven orders, can
+        deadlock.  Empty list == observed behavior is consistent with
+        the static XF007 model."""
+        combined: dict[str, set[str]] = {
+            a: set(bs) for a, bs in static_edges.items()
+        }
+        for a, bs in self.edges().items():
+            combined.setdefault(a, set()).update(bs)
+        out = []
+        for cycle in _find_cycles(combined):
+            pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+            if all(b in static_edges.get(a, ()) for a, b in pairs):
+                continue  # purely static cycle: XF007's finding, not ours
+            out.append(" -> ".join(cycle + (cycle[0],)))
+        return out
+
+
+_GLOBAL = LockOrderSanitizer()
+
+
+def global_sanitizer() -> LockOrderSanitizer:
+    """The process-wide instance Config-armed runtime code reports to."""
+    return _GLOBAL
+
+
+def maybe_instrument(
+    obj: Any,
+    attr: str,
+    name: str | None = None,
+    sanitizer: LockOrderSanitizer | None = None,
+    environ: Mapping[str, str] = os.environ,
+) -> _InstrumentedLock | None:
+    """Instrument ``obj.<attr>`` only when the sanitizer is armed;
+    otherwise a no-op returning None (the plain lock stays — zero
+    overhead off)."""
+    if sanitizer is None:
+        if not armed(environ):
+            return None
+        sanitizer = _GLOBAL
+    return sanitizer.instrument(obj, attr, name)

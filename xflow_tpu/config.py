@@ -134,6 +134,13 @@ class Config:
     # Monitor poll interval (0 = auto: a quarter of the tightest
     # threshold, so a stall is classified within its threshold).
     obs_watchdog_poll_s: float = 0.0
+    # Lock-order sanitizer (analysis/sanitizer.py): instrument the
+    # obs-stack locks (MetricsLogger/FlightRecorder/Watchdog/registry)
+    # so actual acquisition orders are recorded and cross-checkable
+    # against the static XF007 graph.  Debug/stress tooling — off in
+    # production (zero overhead when off: plain threading.Lock stays).
+    # The XFLOW_LOCK_SANITIZER env var arms the same machinery.
+    obs_lock_sanitizer: bool = False
 
     # -- eval / artifacts --
     # Prediction dump target.  With pred_style="single" (default) rank 0

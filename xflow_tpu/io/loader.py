@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import warnings
 from typing import Callable, Iterator
 
 from xflow_tpu.io.batch import Batch, ParsedBlock, pack_batch
@@ -325,7 +326,8 @@ class ShardLoader:
         """iter_batches with parse/pack running on a background thread,
         ``depth`` batches ahead of the consumer."""
         return _prefetch_iter(
-            self.iter_batches(start_offset, parse_workers), depth
+            self.iter_batches(start_offset, parse_workers), depth,
+            obs=self.obs,
         )
 
     def count_examples(self) -> int:
@@ -360,9 +362,12 @@ class _PrefetchIter:
     iterator as a context manager elsewhere.  ``depth <= 0`` degrades
     to a synchronous passthrough with the same close() surface."""
 
-    def __init__(self, it: Iterator, depth: int):
+    def __init__(self, it: Iterator, depth: int, obs=None):
         self._source = it
         self._closed = False
+        self._close_done = False
+        self._close_lock = threading.Lock()
+        self._obs = obs if obs is not None else NULL_OBS
         self._thread: threading.Thread | None = None
         if depth <= 0:
             return
@@ -372,11 +377,18 @@ class _PrefetchIter:
         self._thread.start()
 
     def _put_or_abort(self, item) -> bool:
+        flight = self._obs.flight
         while not self._stop.is_set():
             try:
                 self._q.put(item, timeout=0.1)
                 return True
             except queue.Full:
+                # XF009 heartbeat: the producer is alive but blocked on
+                # a full queue — a 'backpressure' beat lets the
+                # watchdog tell a wedged CONSUMER (loader beating, no
+                # consumption) from a dead input pipeline (no beats)
+                if flight is not None:
+                    flight.note_loader("backpressure")
                 continue
         return False
 
@@ -410,10 +422,21 @@ class _PrefetchIter:
 
     def close(self, join_timeout: float = 5.0) -> None:
         """Stop the producer thread and release its resources.
-        Idempotent; safe from any thread."""
+        Idempotent; safe from any thread.  A producer that OUTLIVES
+        the join (wedged in parse/read, not on the queue) is surfaced
+        — warning, ``loader.leaked_threads`` counter, and a ``health``
+        row — instead of silently leaking with its open shard file."""
         self._closed = True
         if self._thread is None:
             return
+        with self._close_lock:
+            # a second close() — sequential (consumer closed directly,
+            # then Trainer.close() reaps _live_prefetch) or concurrent
+            # (the "safe from any thread" contract) — must not pay
+            # another join_timeout or double-report a wedged producer
+            if self._close_done:
+                return
+            self._close_done = True
         self._stop.set()
         # drain so a producer blocked on a full queue observes the
         # stop event on its next timeout tick at the latest
@@ -423,6 +446,27 @@ class _PrefetchIter:
         except queue.Empty:
             pass
         self._thread.join(timeout=join_timeout)
+        if self._thread.is_alive():
+            warnings.warn(
+                "prefetch producer thread outlived its close() join "
+                f"({join_timeout:.1f}s) — it is wedged in parse/read "
+                "and still holds the shard file open",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            self._obs.counter("loader.leaked_threads")
+            flight = self._obs.flight
+            if flight is not None and flight.metrics_logger is not None:
+                from xflow_tpu.obs.schema import health_row
+
+                flight.metrics_logger.log("health", health_row(
+                    cause="prefetch_thread_leak",
+                    channel="loader",
+                    silence_seconds=join_timeout,
+                    threshold_seconds=join_timeout,
+                    detail="producer outlived close() join",
+                    channels=flight.snapshot()["channels"],
+                ))
 
     @property
     def alive(self) -> bool:
@@ -435,5 +479,5 @@ class _PrefetchIter:
         self.close()
 
 
-def _prefetch_iter(it: Iterator, depth: int) -> _PrefetchIter:
-    return _PrefetchIter(it, depth)
+def _prefetch_iter(it: Iterator, depth: int, obs=None) -> _PrefetchIter:
+    return _PrefetchIter(it, depth, obs=obs)

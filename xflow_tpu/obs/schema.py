@@ -203,6 +203,29 @@ OPTIONAL: dict[str, dict[str, Any]] = {
 }
 
 
+def health_row(
+    cause: str,
+    channel: str,
+    silence_seconds: float,
+    threshold_seconds: float,
+    detail: str,
+    channels: dict | None = None,
+) -> dict:
+    """A schema-complete ``health`` record body.  Every emitter
+    (watchdog trips/recoveries, loader prefetch-leak, batcher
+    worker-leak, gate smokes) builds the row HERE so a field added to
+    the ``health`` schema breaks one constructor, not N inlined
+    dicts."""
+    return {
+        "cause": cause,
+        "channel": channel,
+        "silence_seconds": round(silence_seconds, 3),
+        "threshold_seconds": round(threshold_seconds, 3),
+        "detail": detail,
+        "channels": channels if channels is not None else {},
+    }
+
+
 def validate_row(row: dict, lineno: int | None = None) -> list[str]:
     """Schema errors for one parsed JSONL row ([] = valid)."""
     where = f"line {lineno}: " if lineno is not None else ""

@@ -129,6 +129,10 @@ class Watchdog:
     # -- monitor ------------------------------------------------------------
 
     def _run(self) -> None:
+        # the watchdog IS the monitor: beating the recorder from here
+        # would make every silent channel look alive, and its own
+        # liveness is observable through the health rows it emits
+        # (xf: ignore[XF009])
         while not self._stop.wait(self.poll_s):
             self.check()
 
@@ -199,14 +203,16 @@ class Watchdog:
     def _health_row(
         self, channel: str, cause: str, threshold: float, age: float
     ) -> dict:
-        row = {
-            "cause": cause,
-            "channel": channel,
-            "silence_seconds": round(age, 3),
-            "threshold_seconds": round(threshold, 3),
-            "detail": self.flight.last_detail(channel) or "",
-            "channels": self.flight.snapshot()["channels"],
-        }
+        from xflow_tpu.obs.schema import health_row
+
+        row = health_row(
+            cause=cause,
+            channel=channel,
+            silence_seconds=age,
+            threshold_seconds=threshold,
+            detail=self.flight.last_detail(channel) or "",
+            channels=self.flight.snapshot()["channels"],
+        )
         if self.metrics_logger is not None:
             self.metrics_logger.log("health", row)
         return row

@@ -388,9 +388,12 @@ class TrainStep:
 
     def _put_batch_impl(self, batch) -> BatchArrays:
         wire, cb = self.host_wire_np(
+            # one-way idempotent latch: racing transfer-ahead workers
+            # can at worst BOTH run the first-batch validation — extra
+            # checking, never missed checking (xf: ignore[XF008])
             batch, check=not self._compact_validated
         )
-        self._compact_validated = True
+        self._compact_validated = True  # same latch; xf: ignore[XF008]
         self._book_wire(
             sum(int(v.nbytes) for v in wire.values()),
             batch.num_real(),

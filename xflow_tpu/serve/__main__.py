@@ -126,6 +126,9 @@ def cmd_bench(args) -> int:
                 e2e.append(dt)
 
     threads = [
+        # bounded workload — each worker drains a finite request slice,
+        # so the untimed join below ends with it; a wedged engine is
+        # the batcher close() join-timeout's job (xf: ignore[XF006])
         threading.Thread(target=worker, args=(rows[i :: args.concurrency],))
         for i in range(args.concurrency)
     ]

@@ -28,6 +28,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import warnings
 from concurrent.futures import Future
 from typing import Any
 
@@ -80,7 +81,13 @@ class MicroBatcher:
 
     @property
     def engine(self):
-        return self._engine
+        # under the swap lock for XF008 discipline: every access to the
+        # swappable reference goes through one guard.  Callers that
+        # need several fields of ONE engine must still capture a single
+        # reference (as _run_batch does) — two property reads can
+        # legitimately straddle a swap().
+        with self._swap_lock:
+            return self._engine
 
     # -- request side ------------------------------------------------------
 
@@ -105,8 +112,12 @@ class MicroBatcher:
     def pending(self) -> bool:
         """Work is queued or in flight — the watchdog's serve-channel
         gate (an idle batcher's silence is healthy, a backed-up one's
-        is a stall)."""
-        return self._busy or not self._q.empty()
+        is a stall).  ``_busy`` is read under the same lock that
+        guards its writes (XF008: the watchdog monitor thread calls
+        this while the worker flips the flag)."""
+        with self._submit_lock:
+            busy = self._busy
+        return busy or not self._q.empty()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -114,13 +125,16 @@ class MicroBatcher:
         """Atomically replace the serving engine (newer artifact).  The
         in-flight batch completes on the old engine; every later batch
         scores on the new one."""
-        if not force and engine.digest != self._engine.digest:
-            raise ValueError(
-                f"hot-swap refused: new engine digest {engine.digest} "
-                f"!= serving digest {self._engine.digest} (different "
-                "config/geometry — pass force=True only if you mean it)"
-            )
         with self._swap_lock:
+            # digest check INSIDE the lock: two racing swaps must not
+            # both pass the check against the same old engine and then
+            # install in arbitrary order (XF008 check-then-act)
+            if not force and engine.digest != self._engine.digest:
+                raise ValueError(
+                    f"hot-swap refused: new engine digest {engine.digest} "
+                    f"!= serving digest {self._engine.digest} (different "
+                    "config/geometry — pass force=True only if you mean it)"
+                )
             self._engine = engine
         self.registry.counter_add("serve.swaps")
 
@@ -151,13 +165,19 @@ class MicroBatcher:
             self.metrics_logger.log("serve_stats", row)
         return row
 
-    def close(self) -> dict:
+    def close(self, join_timeout: float = 60.0) -> dict:
         """Drain the queue, stop the worker, flush ONE final
         ``serve_stats`` row; returns it.  Idempotent AND thread-safe:
         concurrent/later closers block on the drain event until the
         first closer has published the final row, so every caller gets
         the same stats (a bare ``first`` flag would let a second closer
-        read ``_final_stats`` before the first finished joining)."""
+        read ``_final_stats`` before the first finished joining).
+
+        The worker join is BOUNDED (XF006): a device call wedged
+        mid-batch must not hang close() forever — after
+        ``join_timeout`` the leak is surfaced (warning + ``health``
+        row for ``obs doctor``) and the stats flush from whatever
+        drained."""
         with self._submit_lock:
             first = not self._closed
             if first:
@@ -165,7 +185,26 @@ class MicroBatcher:
                 self._q.put(_STOP)
         if first:
             try:
-                self._thread.join()
+                self._thread.join(timeout=join_timeout)
+                if self._thread.is_alive():
+                    warnings.warn(
+                        "MicroBatcher worker thread outlived its "
+                        f"close() join ({join_timeout:.1f}s) — a device "
+                        "call is likely wedged; stats below cover only "
+                        "what drained",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                    if self.metrics_logger is not None:
+                        from xflow_tpu.obs.schema import health_row
+
+                        self.metrics_logger.log("health", health_row(
+                            cause="serve_worker_leak",
+                            channel="serve",
+                            silence_seconds=join_timeout,
+                            threshold_seconds=join_timeout,
+                            detail="worker outlived close() join",
+                        ))
                 self._final_stats = self.emit_stats()
             finally:
                 # set even on failure: a raising first closer must not

@@ -152,6 +152,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--obs-watchdog-device-s", type=float, dest="obs_watchdog_device_s",
         help="device-hang silence threshold, seconds",
     )
+    p.add_argument(
+        "--obs-lock-sanitizer", action="store_true", default=None,
+        dest="obs_lock_sanitizer",
+        help="arm the lock-order sanitizer (analysis/sanitizer.py): "
+        "instrument the obs-stack locks so actual acquisition orders "
+        "are recorded and cross-checkable against the static XF007 "
+        "graph (docs/ANALYSIS.md); debug/stress tooling, zero "
+        "overhead when off",
+    )
     p.add_argument("--profile-dir", dest="profile_dir")
     p.add_argument("--profile-steps", type=int, dest="profile_steps")
     p.add_argument("--profile-start-step", type=int, dest="profile_start_step")

@@ -89,6 +89,12 @@ class Trainer:
         # closed explicitly by close() so abandoned producer threads
         # (crash, preemption, consumer break) never outlive the Trainer.
         self._live_prefetch: set = set()
+        # Live transfer-ahead generators (_transfer_ahead): a mid-epoch
+        # break (preemption) leaves the generator suspended inside its
+        # `with ThreadPoolExecutor`, executor threads alive, until GC.
+        # close() reaps them explicitly (XF006 — the _PrefetchIter leak
+        # class, executor edition).
+        self._live_transfer: set = set()
         # Observability (obs/__init__.py): a live tracer/registry bundle
         # when metrics or tracing is requested, else the shared no-op
         # NULL_OBS (zero per-step allocation).  Threaded into the step
@@ -157,6 +163,26 @@ class Trainer:
                 log=self._log,
             )
             self._watchdog.start()
+        # Lock-order sanitizer (analysis/sanitizer.py): when armed —
+        # Config flag or XFLOW_LOCK_SANITIZER env — the obs-stack locks
+        # are swapped for instrumented wrappers so real acquisition
+        # orders can be cross-checked against the static XF007 graph
+        # (scripts/check_concurrency.py).  The bare env-var presence
+        # check only gates the IMPORT (off = nothing imported or
+        # allocated); armed() is the one authoritative parse.
+        if cfg.obs_lock_sanitizer or os.environ.get("XFLOW_LOCK_SANITIZER"):
+            from xflow_tpu.analysis.sanitizer import armed, global_sanitizer
+
+            if cfg.obs_lock_sanitizer or armed():
+                san = global_sanitizer()
+                for obj in (
+                    self.metrics_logger,
+                    self._flight,
+                    self._watchdog,
+                    self.obs.registry,
+                ):
+                    if obj is not None and hasattr(obj, "_lock"):
+                        san.instrument(obj, "_lock")
         self._profiled = False
         self._preempted = False
         self._preempt_agreed = False
@@ -240,6 +266,13 @@ class Trainer:
         call this) to cover every other exit."""
         if self._watchdog is not None:
             self._watchdog.stop()
+        for gen in list(self._live_transfer):
+            # GeneratorExit at the suspended yield -> _transfer_ahead's
+            # abandon path -> shutdown(wait=False, cancel_futures=True):
+            # idle ring workers exit on the signal, and a WEDGED one
+            # cannot hang this (crash/preemption) cleanup path
+            gen.close()
+        self._live_transfer.clear()
         for it in list(self._live_prefetch):
             it.close()
         self._live_prefetch.clear()
@@ -517,7 +550,8 @@ class Trainer:
 
         if depth is None:
             depth = self.cfg.transfer_ahead
-        with ThreadPoolExecutor(min(2, depth)) as ex:
+        ex = ThreadPoolExecutor(min(2, depth))
+        try:
             pending: deque = deque()
             for batch, si, resume in it:
                 pending.append(
@@ -533,6 +567,17 @@ class Trainer:
             while pending:
                 fut, psi, presume = pending.popleft()
                 yield fut.result(), psi, presume
+            ex.shutdown()  # normal path: workers idle, returns fast
+        except BaseException:
+            # abandon (GeneratorExit from close(), a worker raising, a
+            # consumer exception): do NOT wait — a worker wedged in a
+            # put_batch h2d transfer would otherwise hang the caller's
+            # cleanup path forever (XF006: shutdown must be bounded).
+            # cancel_futures drops the un-started queue; idle workers
+            # exit on the shutdown signal; a wedged in-flight worker is
+            # left to finish on its own rather than held against.
+            ex.shutdown(wait=False, cancel_futures=True)
+            raise
 
     def prepare_batch(self, batch: Batch) -> Batch:
         """Bring an externally built Batch (raw hash-space keys, see
@@ -591,6 +636,9 @@ class Trainer:
         ahead = self.num_hosts == 1
         if ahead:
             stream = self._transfer_ahead(stream)
+            # reaped below on the normal path; by Trainer.close() when
+            # an exception (or an unclosed preemption) abandons it
+            self._live_transfer.add(stream)
         it = iter(stream)
         with obs.span("train_epoch", {"epoch": self.epoch}):
             while True:
@@ -648,6 +696,12 @@ class Trainer:
             with obs.phase("device_block"):
                 host_metrics = jax.device_get(device_metrics)
             self._pulse("idle")  # epoch compute over — silence is benign
+        if ahead:
+            # no-op when the stream ran dry; on a preemption break it
+            # shuts the staging-ring executor down NOW instead of
+            # leaving its threads to the garbage collector
+            self._live_transfer.discard(stream)
+            stream.close()
         seen = float(sum(m["count"] for m in host_metrics))
         ll_sum = float(
             sum(m["logloss"] * m["count"] for m in host_metrics)
