@@ -1,0 +1,167 @@
+"""Sharding & memory gate: the XF010–XF014 static pass plus the
+transient-HBM budget report, gating the shape/dtype/sharding/memory
+invariants before the pod-scale sharding work (ROADMAP item 2)
+multiplies the surface.
+
+Run from the repo root:
+
+    python scripts/check_memory.py
+    python scripts/check_memory.py --write-budget   # regenerate candidates
+
+Three parts, all must pass:
+
+1. **Static** — ``xflow_tpu.analysis`` with the five memory rules
+   (XF010 full-table transients, XF011 dtype discipline, XF012
+   sharding coverage, XF013 donation safety, XF014 transient budget —
+   docs/ANALYSIS.md) over the whole package against the committed
+   baseline, same contract as scripts/check_analysis.py.
+2. **Budget presence** — ``memory-budget.json`` must exist at the repo
+   root: XF014 is deliberately silent when no budget file is in scope
+   (fixture scans), so the gate — not the rule — refuses a deleted
+   budget.
+3. **Report** — the per-jit transient estimate at the north-star
+   geometry (T=2^28, flagship D per model family) is printed for every
+   jit entry, with its budget and the largest contributing site — the
+   number ROADMAP item 2's sharding work budgets against.
+
+``--write-budget`` rewrites the ``budgets`` section from the current
+estimates (+10% headroom, rounded), carrying comment fields — review
+the diff before committing; raising a budget is a design decision
+(docs/ANALYSIS.md XF014 policy).
+
+Wired into tier-1 via tests/test_memory_analysis.py, next to
+check_analysis.py / check_concurrency.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+MEMORY_RULES = ["XF010", "XF011", "XF012", "XF013", "XF014"]
+
+
+def check_static(index, baseline_path: str) -> int:
+    from xflow_tpu.analysis import (
+        load_baseline,
+        render_text,
+        run_analysis,
+        split_baselined,
+    )
+
+    # the shared index carries the cached shapeflow MemoryContext, so
+    # the static pass reuses report_estimates' interpretation run
+    findings, pragma_suppressed = run_analysis(
+        index, select=MEMORY_RULES
+    )
+    entries = [
+        e for e in load_baseline(baseline_path) if e["rule"] in MEMORY_RULES
+    ]
+    new, grandfathered, stale = split_baselined(findings, entries)
+    print(render_text(new, grandfathered, pragma_suppressed, stale))
+    if new:
+        return 1
+    if stale:
+        print(
+            "FAIL: stale baseline entries (prune analysis-baseline.json)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def _gib(n: int) -> str:
+    return f"{n / 2**30:.3f} GiB" if n >= 1 << 20 else f"{n} B"
+
+
+def report_estimates(index, budget_path: str,
+                     write: bool = False) -> int:
+    from xflow_tpu.analysis import estimate_transients, load_budget
+
+    doc = load_budget(budget_path)
+    estimates = estimate_transients(index, doc)
+    if not estimates:
+        print("FAIL: no jit entries discovered — shapeflow regression?")
+        return 1
+    rc = 0
+    budgets = doc["budgets"]
+    print("per-jit transient estimates at the north-star geometry "
+          f"(T=2^{doc['geometry']['T'].bit_length() - 1}):")
+    for key, fams in sorted(estimates.items()):
+        entry = budgets.get(key, {})
+        for family, est in sorted(fams.items()):
+            allowed = entry.get(family)
+            ok = allowed is not None and est["bytes"] <= int(allowed)
+            top = est["sites"][0] if est["sites"] else None
+            where = (
+                f"  largest: {top['shape']} {top['kind']} "
+                f"{top['path']}:{top['line']}"
+                if top
+                else ""
+            )
+            status = "ok" if ok else "FAIL"
+            budget_s = _gib(int(allowed)) if allowed is not None else "NONE"
+            print(
+                f"  {status:4s} {key} [{family}] "
+                f"{_gib(est['bytes'])} / budget {budget_s}{where}"
+            )
+            if not ok:
+                rc = 1
+            if est["unsized"]:
+                print(
+                    f"       note: {est['unsized']} transient(s) the "
+                    "flow could not size (not counted)"
+                )
+    if write:
+        for key, fams in sorted(estimates.items()):
+            old = budgets.get(key, {})
+            # rebuild families from the live estimates (stale family
+            # values would silently re-arm if the name ever returned);
+            # carry non-numeric fields (comments) across
+            entry = {
+                k: v for k, v in old.items()
+                if not isinstance(v, (int, float))
+            }
+            for family, est in fams.items():
+                entry[family] = int(est["bytes"] * 1.1)
+            budgets[key] = entry
+        stale = [k for k in budgets if k not in estimates]
+        for k in stale:
+            del budgets[k]
+        with open(budget_path, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"wrote budget candidates (+10%) to {budget_path} — "
+              "review the diff before committing")
+        return 0
+    return rc
+
+
+def main(argv: list[str] | None = None) -> int:
+    from xflow_tpu.analysis.core import PackageIndex
+
+    write = "--write-budget" in (argv if argv is not None else sys.argv[1:])
+    package = os.path.join(REPO, "xflow_tpu")
+    baseline = os.path.join(REPO, "analysis-baseline.json")
+    budget = os.path.join(REPO, "memory-budget.json")
+    if not os.path.exists(budget):
+        # XF014 is silent without a budget in scope — the gate is what
+        # makes deleting the committed file a failure, not a pass
+        print(f"FAIL: {budget} missing — the XF014 transient budget "
+              "must stay committed", file=sys.stderr)
+        return 1
+    index = PackageIndex([package])  # one parse + interpretation, shared
+    rc = report_estimates(index, budget, write=write)
+    if write:
+        return rc
+    rc = check_static(index, baseline) or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

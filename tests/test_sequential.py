@@ -442,6 +442,68 @@ def test_sequential_hot_inner_spill_trains():
             )
 
 
+@pytest.mark.parametrize("model", ["lr", "fm"])
+def test_hot_windowend_sparse_matches_dense(model):
+    """Config.hot_windowend='sparse' routes the window-end cold-tail
+    pass through the consolidated touched-rows update (ops/sparse.py)
+    instead of a [T, D] buffer + full-table optimizer pass — the
+    T=2^28 form (analysis rules XF010/XF014).  Same training on
+    duplicate-heavy cold traffic WITH hot-overflow spill (cold-plane
+    keys < H landing on the written-back head, exactly once)."""
+    rng = np.random.default_rng(41)
+    keys, slots, vals, mask, labels, weights = rand_batch(rng, B)
+    # heavy hot traffic with spill (8 of 12 columns vs hot_nnz=4) AND
+    # duplicate-heavy cold keys >= H
+    keys[:, :8] = rng.integers(0, 16, (B, 8)).astype(np.int32)
+    keys[:, 8:] = (
+        (1 << 8) + rng.integers(0, 32, (B, K - 8))
+    ).astype(np.int32)
+    raw = (keys, slots, vals, mask, labels, weights)
+    out = {}
+    for windowend in ("dense", "sparse"):
+        cfg = base_cfg(
+            model,
+            update_mode="sequential",
+            microbatch=M,
+            sequential_inner="hot",
+            hot_size_log2=8,
+            hot_nnz=4,
+            hot_windowend=windowend,
+        )
+        step, state = build(model, cfg)
+        assert step._windowend == windowend
+        state, _ = step.train(
+            state, step.put_batch(make_batch(*raw, 1 << 8, 4))
+        )
+        out[windowend] = jax.device_get(state)
+    for name in out["dense"]["tables"]:
+        for part in out["dense"]["tables"][name]:
+            np.testing.assert_allclose(
+                np.asarray(out["sparse"]["tables"][name][part]),
+                np.asarray(out["dense"]["tables"][name][part]),
+                rtol=1e-5,
+                atol=1e-7,
+                err_msg=f"{model}:{name}/{part}",
+            )
+
+
+def test_hot_windowend_auto_routes_by_table_size():
+    """auto = dense below 2^24 (full-table pass is noise there),
+    sparse from 2^24 up (the [T, D] transient is the hazard)."""
+    small = base_cfg(
+        "lr", update_mode="sequential", microbatch=M,
+        sequential_inner="hot", hot_size_log2=8, hot_nnz=4,
+    )
+    step, _ = build("lr", small)
+    assert step._windowend == "dense"
+    big = small.replace(table_size_log2=24)
+    mesh = make_mesh(big.num_devices)
+    big_step = TrainStep(
+        make_model(big), make_optimizer(big), big, mesh
+    )
+    assert big_step._windowend == "sparse"
+
+
 def test_hot_inner_requires_hot_table():
     with pytest.raises(ValueError, match="hot"):
         base_cfg("lr", update_mode="sequential", sequential_inner="hot")
@@ -461,6 +523,27 @@ def test_hot_inner_rejects_mxu_opted_out_tables():
     )
     with pytest.raises(ValueError, match="opts table"):
         build("ffm", cfg)
+
+
+def test_mxu_opted_out_inner_hot_legal_outside_sequential():
+    """ADVICE round-5 low #2 regression: the hot-inner/opt-out check
+    only applies when the hot inner RUNS (update_mode='sequential').
+    ffm + dense mode + sequential_inner='hot' is a legal Config (the
+    inner is an unused knob there) and must build and train."""
+    rng = np.random.default_rng(43)
+    raw = rand_batch(rng, B)
+    cfg = base_cfg(
+        "ffm",
+        update_mode="dense",
+        sequential_inner="hot",
+        hot_size_log2=8,
+        hot_nnz=4,
+    )
+    step, state = build("ffm", cfg)  # used to raise at build
+    state, metrics = step.train(
+        state, step.put_batch(make_batch(*raw, 1 << 8, 4))
+    )
+    assert np.isfinite(float(jax.device_get(metrics["logloss"])))
 
 
 @pytest.mark.parametrize(

@@ -45,6 +45,24 @@ both:
 * XF009 heartbeat coverage — unbounded worker loops in hot-path
   modules must pulse the flight-recorder heartbeat.
 
+Memory rules (ISSUE 7; rules_memory.py) ride a symbolic shape/dtype
+dataflow (shapeflow.py) that propagates dims seeded from ``Config``
+caps (T/B/K/Kh/H/S/D) through jitted traces:
+
+* XF010 full-table transients — ``zeros_like(table)`` /
+  ``one_hot(keys, T)`` materializations inside jit (multi-GB at the
+  north-star T=2^28);
+* XF011 dtype discipline — ad-hoc uint64->int32 key narrowing outside
+  ``io/batch.py::narrow_keys_i32``, explicit float64 in traced code;
+* XF012 sharding coverage — unsharded ``device_put`` in hot paths,
+  shardings constructed outside parallel/mesh.py, unknown collective
+  axis names;
+* XF013 donation safety — ``donate_argnums`` buffers read after the
+  donating call;
+* XF014 transient-HBM budget — per-jit transient estimates at the
+  north-star geometry gated against the committed
+  ``memory-budget.json`` (scripts/check_memory.py).
+
 Suppression: ``# xf: ignore[XF001]`` on the finding line, or
 ``# xf: ignore-file[XF001]`` anywhere in the file; a committed baseline
 file (``analysis-baseline.json``) grandfathers legacy findings without
@@ -68,9 +86,17 @@ from xflow_tpu.analysis.core import (
 )
 from xflow_tpu.analysis.report import render_json, render_text
 from xflow_tpu.analysis.rules_concurrency import static_lock_order
+from xflow_tpu.analysis.rules_memory import (
+    estimate_transients,
+    find_budget,
+    load_budget,
+)
 from xflow_tpu.analysis.sanitizer import LockOrderSanitizer
 
 __all__ = [
+    "estimate_transients",
+    "find_budget",
+    "load_budget",
     "Finding",
     "PackageIndex",
     "Rule",

@@ -194,6 +194,13 @@ def all_rules() -> list[Rule]:
         ThreadLifecycle,
     )
     from xflow_tpu.analysis.rules_jax import HiddenHostSyncs, RecompileHazards
+    from xflow_tpu.analysis.rules_memory import (
+        DonationSafety,
+        DtypeDiscipline,
+        FullTableTransient,
+        ShardingCoverage,
+        TransientBudget,
+    )
     from xflow_tpu.analysis.rules_schema import SchemaDrift
     from xflow_tpu.analysis.rules_threads import LockDiscipline
 
@@ -207,6 +214,11 @@ def all_rules() -> list[Rule]:
         LockOrder(),
         SharedStateDiscipline(),
         HeartbeatCoverage(),
+        FullTableTransient(),
+        DtypeDiscipline(),
+        ShardingCoverage(),
+        DonationSafety(),
+        TransientBudget(),
     ]
 
 
@@ -219,9 +231,11 @@ def run_analysis(
 
     Returns ``(findings, pragma_suppressed)`` — baseline filtering is a
     separate step (baseline.split_baselined) so callers can report the
-    grandfathered set.
+    grandfathered set.  ``paths`` may be a ready-built ``PackageIndex``
+    so callers that also need the index (scripts/check_memory.py's
+    estimate report) parse and interpret the tree once, not twice.
     """
-    index = PackageIndex(paths)
+    index = paths if isinstance(paths, PackageIndex) else PackageIndex(paths)
     rule_list = list(rules) if rules is not None else all_rules()
     if select is not None:
         wanted = set(select)

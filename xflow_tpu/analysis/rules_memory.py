@@ -1,0 +1,738 @@
+"""XF010–XF014 — sharding & memory rules over the symbolic shape/dtype
+dataflow (analysis/shapeflow.py).
+
+ROADMAP item 2 (pod-scale embedding sharding at T=2^28) is blocked by
+exactly one hazard class: jitted code that silently materializes a
+full-table ``[T, ...]`` transient — multi-GB per table at north-star
+scale — or narrows the uint64 key space carelessly on the way to the
+int32 batch planes.  PR 6 gated the thread fabric before the N-stream
+fan-out; these rules gate the shape/dtype/sharding/memory invariants
+before the sharding work multiplies the surface:
+
+* **XF010 full-table transient hazard** — a ``zeros_like(table)`` /
+  ``zeros((T, ...))`` allocation or a ``one_hot(keys, T)`` expansion
+  inside a jitted trace.  The dense update mode allocates ``[T, D]``
+  gradient buffers BY DESIGN (small-table form) — those sites carry
+  justified pragmas; anything new must be routed through the
+  touched-rows machinery (ops/sparse.py) or justified the same way.
+* **XF011 dtype discipline** — (a) ad-hoc ``.astype(np.int32)`` /
+  ``np.int32(...)`` narrowing of key planes: the uint64 key space must
+  narrow through the ONE audited choke point
+  (``io/batch.py::narrow_keys_i32``) so a future table-size bump can't
+  silently wrap; (b) explicit float64 (``np.float64`` / ``dtype=float``)
+  inside traced code — weak-type promotion doubles every downstream
+  buffer.
+* **XF012 sharding coverage** — ``jax.device_put`` without a sharding
+  in hot-path modules, ``NamedSharding``/``PartitionSpec`` constructed
+  outside ``parallel/mesh.py`` (the helpers are the one source of
+  layout truth), and collective axis names that don't match the mesh's
+  declared axes.
+* **XF013 donation safety** — a buffer passed in a ``donate_argnums``
+  position is dead after the call; reading it afterwards is
+  use-after-donate (garbage on TPU, silent aliasing elsewhere).
+* **XF014 transient-HBM budget** — per jit entry, the summed bytes of
+  every transient the flow can size, evaluated at the north-star
+  geometry (T=2^28, flagship D per model family), gated against the
+  committed ``memory-budget.json`` baseline-style: estimates over
+  budget, entries missing for new jits, and stale entries all fail.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Any, Iterator
+
+from xflow_tpu.analysis.core import (
+    Finding,
+    PackageIndex,
+    Rule,
+    SourceFile,
+    dotted_name,
+    walk_scoped,
+)
+from xflow_tpu.analysis.rules_concurrency import get_context
+from xflow_tpu.analysis.shapeflow import (
+    ArrV,
+    ConfigV,
+    MapV,
+    MemoryContext,
+    UNK,
+    dsym,
+    get_memory_context,
+    shape_str,
+)
+
+DEFAULT_BUDGET = "memory-budget.json"
+
+# the sanctioned u64 -> i32 narrowing choke point (io/batch.py)
+NARROW_HELPER = "narrow_keys_i32"
+
+_HOT_PATH_PREFIXES = ("parallel/", "serve/", "ops/", "io/")
+_HOT_PATH_FILES = ("trainer.py",)
+
+_COLLECTIVE_LEAVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "axis_index",
+    "ppermute", "pshuffle", "all_to_all",
+}
+
+
+def _is_hot_path(rel: str) -> bool:
+    if rel in _HOT_PATH_FILES or any(
+        rel.endswith("/" + f) for f in _HOT_PATH_FILES
+    ):
+        return True
+    return any(
+        rel.startswith(p) or ("/" + p) in rel for p in _HOT_PATH_PREFIXES
+    )
+
+
+# -- seeds -----------------------------------------------------------------
+#
+# Parameter-name conventions of the jit entries (parallel/step.py): the
+# State pytree, the batch plane dict, config.  Callees get their values
+# from the call-site flow, so these only matter at entry functions.
+
+_T, _D, _B, _K, _Kh, _H = (
+    dsym("T"), dsym("D"), dsym("B"), dsym("K"), dsym("Kh"), dsym("H")
+)
+
+
+def _table() -> MapV:
+    return MapV({}, lambda: ArrV((_T, _D), "float32"))
+
+
+def _batch() -> MapV:
+    f32 = "float32"
+    return MapV(
+        {
+            "keys": ArrV((_B, _K), "int32"),
+            "slots": ArrV((_B, _K), "int32"),
+            "vals": ArrV((_B, _K), f32),
+            "mask": ArrV((_B, _K), f32),
+            "hot_keys": ArrV((_B, _Kh), "int32"),
+            "hot_slots": ArrV((_B, _Kh), "int32"),
+            "hot_vals": ArrV((_B, _Kh), f32),
+            "hot_mask": ArrV((_B, _Kh), f32),
+            "labels": ArrV((_B,), f32),
+            "weights": ArrV((_B,), f32),
+        },
+        None,
+    )
+
+
+def seed_param(name: str) -> Any:
+    if name == "state":
+        return MapV(
+            {
+                "tables": MapV({}, _table),
+                "dense": UNK,
+                "step": ArrV((), "int32"),
+            },
+            None,
+        )
+    if name == "tables":
+        return MapV({}, _table)
+    if name in ("table", "head", "t"):
+        return _table()
+    if name in ("batch", "arrays", "bslice", "w"):
+        return _batch()
+    if name in ("cfg", "config"):
+        return ConfigV()
+    if name == "w_hot":
+        return ArrV((_H, _D), "float32")
+    return UNK
+
+
+def seed_self_attr(attr: str) -> Any:
+    if attr in ("cfg", "config"):
+        return ConfigV()
+    return UNK
+
+
+def memory_context(index: PackageIndex) -> MemoryContext:
+    return get_memory_context(index, seed_param, seed_self_attr)
+
+
+# -- XF010 -----------------------------------------------------------------
+
+
+class FullTableTransient(Rule):
+    id = "XF010"
+    title = "full-table [T, ...] transient inside a jitted trace"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        mem = memory_context(index)
+        seen: set[tuple[str, int]] = set()
+        for key, transients in sorted(mem.flows.items()):
+            for t in transients:
+                site = (t.sf.rel, t.line)
+                if site in seen:
+                    continue
+                hazard = None
+                if t.kind == "alloc" and t.shape and t.shape[0] == _T:
+                    hazard = (
+                        f"allocates a full-table {shape_str(t.shape)} "
+                        "transient"
+                    )
+                elif t.kind == "one_hot" and t.shape and t.shape[-1] == _T:
+                    hazard = (
+                        f"one-hot expands into the T dim "
+                        f"({shape_str(t.shape)})"
+                    )
+                if hazard is None:
+                    continue
+                seen.add(site)
+                yield Finding(
+                    rule=self.id,
+                    path=t.sf.rel,
+                    line=t.line,
+                    message=(
+                        f"jitted trace {hazard} — multi-GB per table at "
+                        "the north-star T=2^28 (ADVICE step.py:945 "
+                        "class); route through the touched-rows update "
+                        "(ops/sparse.py consolidate + gather/scatter, "
+                        "Config.hot_windowend) or justify with a pragma "
+                        "(docs/ANALYSIS.md XF010)"
+                    ),
+                )
+
+
+# -- XF011 -----------------------------------------------------------------
+
+
+def _expr_mentions_key(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and "key" in node.id.lower():
+            return True
+        if isinstance(node, ast.Attribute) and "key" in node.attr.lower():
+            return True
+        if isinstance(node, ast.Constant) and isinstance(
+            node.value, str
+        ) and "key" in node.value.lower():
+            return True
+    return False
+
+
+def _is_np_int32(expr: ast.AST) -> bool:
+    name = dotted_name(expr)
+    if name is not None:
+        head, _, leaf = name.rpartition(".")
+        return leaf == "int32" and head in ("np", "numpy")
+    return isinstance(expr, ast.Constant) and expr.value in ("int32", "i4")
+
+
+class DtypeDiscipline(Rule):
+    id = "XF011"
+    title = "uint64-key narrowing / float64 promotion discipline"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        mem = memory_context(index)
+        ctx = get_context(index)
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            yield from self._check_key_narrowing(ctx, sf)
+        for fn in ctx.fns:
+            if id(fn) in mem.traced:
+                yield from self._check_float64(fn)
+
+    # -- (a) ad-hoc int32 narrowing of key planes -----------------------
+
+    def _check_key_narrowing(self, ctx, sf: SourceFile) -> Iterator[Finding]:
+        # functions named after the helper ARE the choke point
+        helper_spans: list[tuple[int, int]] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and node.name == NARROW_HELPER:
+                helper_spans.append(
+                    (node.lineno, node.end_lineno or node.lineno)
+                )
+
+        def in_helper(lineno: int) -> bool:
+            return any(a <= lineno <= b for a, b in helper_spans)
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if in_helper(getattr(node, "lineno", 0)):
+                continue
+            func = node.func
+            # X.astype(np.int32) where X mentions a key plane
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "astype"
+                and node.args
+                and _is_np_int32(node.args[0])
+                and _expr_mentions_key(func.value)
+            ):
+                yield self.finding(
+                    sf, node,
+                    "ad-hoc .astype(np.int32) on a key plane — the "
+                    "uint64 key space must narrow through "
+                    f"io/batch.py::{NARROW_HELPER} (range-checked once, "
+                    "auditable everywhere) so a table_size bump past "
+                    "2^31 cannot silently wrap (XF011)",
+                )
+            # np.int32(keys-ish-expr)
+            elif (
+                _is_np_int32(func)
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+                and _expr_mentions_key(node.args[0])
+            ):
+                yield self.finding(
+                    sf, node,
+                    "np.int32(...) coercion of a key expression — use "
+                    f"io/batch.py::{NARROW_HELPER} for uint64->int32 "
+                    "key narrowing (XF011)",
+                )
+
+    # -- (b) explicit float64 in traced code ----------------------------
+
+    def _check_float64(self, fn) -> Iterator[Finding]:
+        for node in walk_scoped(fn.node):
+            bad: str | None = None
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name is not None and name.rsplit(".", 1)[-1] == "float64":
+                    bad = f"{name}(...)"
+                for kw in node.keywords:
+                    if kw.arg != "dtype":
+                        continue
+                    dt = dotted_name(kw.value)
+                    if dt is not None and dt.rsplit(".", 1)[-1] in (
+                        "float64",
+                        "float",
+                    ):
+                        bad = f"dtype={dt}"
+                    elif isinstance(kw.value, ast.Constant) and (
+                        kw.value.value == "float64"
+                    ):
+                        bad = "dtype='float64'"
+            if bad:
+                yield self.finding(
+                    fn.sf, node,
+                    f"{bad} inside traced function {fn.qualname!r} — "
+                    "float64 weak-type promotion doubles every "
+                    "downstream buffer (and x86-emulates on TPU); keep "
+                    "traced math in float32/bfloat16 (XF011)",
+                )
+
+
+# -- XF012 -----------------------------------------------------------------
+
+
+class ShardingCoverage(Rule):
+    id = "XF012"
+    title = "unsharded device_put / ad-hoc sharding / unknown mesh axis"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        axes = self._declared_axes(index)
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            is_mesh_mod = sf.rel.endswith("mesh.py")
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                leaf = (
+                    name.rsplit(".", 1)[-1]
+                    if name
+                    else (
+                        node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else None
+                    )
+                )
+                if leaf == "device_put" and _is_hot_path(sf.rel):
+                    if len(node.args) < 2 and not any(
+                        kw.arg in ("device", "sharding", "dst")
+                        for kw in node.keywords
+                    ):
+                        yield self.finding(
+                            sf, node,
+                            "jax.device_put without a sharding in a "
+                            "hot-path module — an unsharded put "
+                            "replicates (or lands on device 0) and "
+                            "silently de-shards table-scale arrays; "
+                            "pass a parallel/mesh.py helper sharding "
+                            "(table_sharding/batch_sharding/replicated)",
+                        )
+                elif leaf in (
+                    "NamedSharding", "PositionalSharding"
+                ) and not is_mesh_mod:
+                    yield self.finding(
+                        sf, node,
+                        f"{leaf} constructed outside parallel/mesh.py — "
+                        "layout truth lives in the mesh helpers "
+                        "(table_sharding/batch_sharding/replicated); "
+                        "ad-hoc shardings drift from the mesh axes "
+                        "(XF012)",
+                    )
+                elif leaf in _COLLECTIVE_LEAVES and axes is not None:
+                    ax = self._axis_arg(node)
+                    if ax is not None and ax not in axes:
+                        yield self.finding(
+                            sf, node,
+                            f"collective {leaf} over axis {ax!r} which "
+                            "parallel/mesh.py never declares (declared: "
+                            f"{sorted(axes)}) — an unknown axis name "
+                            "fails at trace time only on multi-device "
+                            "meshes (XF012)",
+                        )
+
+    @staticmethod
+    def _axis_arg(node: ast.Call) -> str | None:
+        for kw in node.keywords:
+            if kw.arg == "axis_name" and isinstance(
+                kw.value, ast.Constant
+            ) and isinstance(kw.value.value, str):
+                return kw.value.value
+        if len(node.args) > 1 and isinstance(
+            node.args[1], ast.Constant
+        ) and isinstance(node.args[1].value, str):
+            return node.args[1].value
+        return None
+
+    @staticmethod
+    def _declared_axes(index: PackageIndex) -> set[str] | None:
+        """String axis names declared by the mesh module: ``*_AXIS``
+        constants plus literals in ``Mesh(..., (axes,))`` tuples.
+        None when no mesh module is in scope (subtree scans)."""
+        sf = index.by_rel("parallel/mesh.py") or index.by_rel("mesh.py")
+        if sf is None or sf.tree is None:
+            return None
+        axes: set[str] = set()
+        consts: dict[str, str] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Constant
+            ) and isinstance(node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        consts[tgt.id] = node.value.value
+                        if tgt.id.endswith("_AXIS"):
+                            axes.add(node.value.value)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name and name.rsplit(".", 1)[-1] == "Mesh":
+                    for arg in node.args[1:]:
+                        if isinstance(arg, (ast.Tuple, ast.List)):
+                            for el in arg.elts:
+                                if isinstance(el, ast.Constant) and (
+                                    isinstance(el.value, str)
+                                ):
+                                    axes.add(el.value)
+                                elif isinstance(el, ast.Name) and (
+                                    el.id in consts
+                                ):
+                                    axes.add(consts[el.id])
+        return axes or None
+
+
+# -- XF013 -----------------------------------------------------------------
+
+
+def _same_ref(a: ast.AST, b: ast.AST) -> bool:
+    """Both plain Name or self-attribute chains with equal spelling."""
+    da, db = dotted_name(a), dotted_name(b)
+    return da is not None and da == db
+
+
+class DonationSafety(Rule):
+    id = "XF013"
+    title = "donated buffer read after the donating call"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        mem = memory_context(index)
+        ctx = get_context(index)
+        donating = [b for b in mem.bindings if b.donate]
+        if not donating:
+            return
+        for fn in ctx.fns:
+            yield from self._check_fn(fn, donating)
+
+    def _check_fn(self, fn, donating) -> Iterator[Finding]:
+        # a donating call nested in an Assign is yielded TWICE by the
+        # walk (as the Assign's value and as a bare Call) — claim the
+        # Assign association first so the rebind idiom stays exempt
+        assigns: dict[int, ast.Assign] = {}
+        for node in walk_scoped(fn.node):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                assigns[id(node.value)] = node
+        calls: list[tuple[ast.Call, tuple[int, ...], ast.AST | None]] = []
+        for node in walk_scoped(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            spec = self._binding_for(fn, node, donating)
+            if spec is not None:
+                calls.append((node, spec, assigns.get(id(node))))
+        for call, donate, assign in calls:
+            for argnum in donate:
+                if argnum >= len(call.args):
+                    continue
+                arg = call.args[argnum]
+                if dotted_name(arg) is None:
+                    continue
+                if assign is not None and any(
+                    self._target_rebinds(t, arg) for t in assign.targets
+                ):
+                    continue  # `state = self.train(state, ...)` idiom
+                read = self._read_after(fn, call, arg)
+                if read is not None:
+                    yield self.finding(
+                        fn.sf, read,
+                        f"{dotted_name(arg)} is donated "
+                        f"(donate_argnums={argnum}) to the jitted call "
+                        f"at line {call.lineno} and read afterwards — "
+                        "a donated buffer is dead after dispatch "
+                        "(garbage on TPU); rebind the result over it "
+                        "(`state = step.train(state, ...)`) or drop "
+                        "donation (XF013)",
+                    )
+                    break
+
+    @staticmethod
+    def _binding_for(fn, call: ast.Call, donating):
+        func = call.func
+        for b in donating:
+            # class-bound jits (self.train = jax.jit(...)) are invoked
+            # through arbitrary receivers at the real call sites
+            # (step.train(...), self.step.train(...)) — match by
+            # attribute NAME package-wide, the same fuzzy over-
+            # approximation PR 6 uses for thread targets: a donated
+            # buffer is rare and explicit, so a false match is a
+            # pragma, a missed one is garbage reads on TPU
+            if (
+                isinstance(func, ast.Attribute)
+                and b.bind_cls is not None
+                and func.attr == b.bind_name
+            ):
+                return b.donate
+            if (
+                isinstance(func, ast.Name)
+                and func.id == b.bind_name
+                and b.bind_cls is None
+                and fn.sf.rel == b.sf.rel
+            ):
+                return b.donate
+        return None
+
+    @staticmethod
+    def _target_rebinds(target: ast.AST, arg: ast.AST) -> bool:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            return any(
+                _same_ref(el, arg) for el in target.elts
+            )
+        return _same_ref(target, arg)
+
+    @staticmethod
+    def _read_after(fn, call: ast.Call, arg: ast.AST) -> ast.AST | None:
+        call_end = getattr(call, "end_lineno", call.lineno)
+        for node in walk_scoped(fn.node):
+            if getattr(node, "lineno", 0) <= call_end:
+                continue
+            if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ) and _same_ref(node, arg):
+                return node
+        return None
+
+
+# -- XF014 -----------------------------------------------------------------
+
+
+def find_budget(index: PackageIndex) -> str | None:
+    """memory-budget.json next to (or one level above) a scan root —
+    repo layout: roots=[REPO/xflow_tpu], budget at REPO/."""
+    for root in index.roots:
+        for base in (root, os.path.dirname(root)):
+            cand = os.path.join(base, DEFAULT_BUDGET)
+            if os.path.exists(cand):
+                return cand
+    return None
+
+
+def load_budget(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    for field in ("geometry", "budgets"):
+        if field not in doc:
+            raise ValueError(f"{path}: budget file missing {field!r}")
+    geo = doc["geometry"]
+    if "families" not in geo:
+        raise ValueError(f"{path}: geometry missing 'families'")
+    return doc
+
+
+def estimate_transients(
+    index: PackageIndex, budget_doc: dict
+) -> dict[str, dict[str, dict]]:
+    """{jit_key: {family: {"bytes": int, "sites": [...], "unsized": n}}}
+    — the per-jit peak-transient estimate at the budget's geometry: a
+    static upper bound summing every transient the flow sized across
+    all branches of the trace (dense/sparse/hot paths included; an
+    estimate is config-independent by design — the budget gates the
+    worst reachable path)."""
+    mem = memory_context(index)
+    geo = budget_doc["geometry"]
+    base_env = {
+        k: int(v) for k, v in geo.items()
+        if k != "families" and isinstance(v, (int, float))
+    }
+    out: dict[str, dict[str, dict]] = {}
+    for key, transients in sorted(mem.flows.items()):
+        per_family: dict[str, dict] = {}
+        for family, d in sorted(geo["families"].items()):
+            env = dict(base_env)
+            env["D"] = int(d)
+            total = 0
+            sites = []
+            unsized = 0
+            for t in transients:
+                nb = t.nbytes(env)
+                if nb is None:
+                    unsized += 1
+                    continue
+                total += nb
+                sites.append(
+                    {
+                        "path": t.sf.rel,
+                        "line": t.line,
+                        "shape": shape_str(t.shape),
+                        "kind": t.kind,
+                        "bytes": nb,
+                    }
+                )
+            sites.sort(key=lambda s: -s["bytes"])
+            per_family[family] = {
+                "bytes": total,
+                "sites": sites,
+                "unsized": unsized,
+            }
+        out[key] = per_family
+    return out
+
+
+class TransientBudget(Rule):
+    id = "XF014"
+    title = "per-jit transient-HBM estimate vs memory-budget.json"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        path = find_budget(index)
+        if path is None:
+            return  # no budget in scope (subtree/fixture scan);
+            # scripts/check_memory.py requires the committed file
+        try:
+            doc = load_budget(path)
+        except (ValueError, json.JSONDecodeError) as e:
+            yield Finding(
+                rule=self.id, path=DEFAULT_BUDGET, line=0,
+                message=f"unreadable budget file: {e}",
+            )
+            return
+        estimates = estimate_transients(index, doc)
+        budgets: dict[str, dict] = doc["budgets"]
+        mem = memory_context(index)
+        lines = {
+            b.key: (b.sf.rel, getattr(b.node, "lineno", 0))
+            for b in mem.bindings
+            if b.impl is not None
+        }
+        for key, per_family in sorted(estimates.items()):
+            rel, lineno = lines.get(key, (DEFAULT_BUDGET, 0))
+            entry = budgets.get(key)
+            if entry is None:
+                yield Finding(
+                    rule=self.id, path=rel, line=lineno,
+                    message=(
+                        f"jit entry {key} has no {DEFAULT_BUDGET} entry "
+                        "— every jitted function needs a committed "
+                        "per-family transient budget (run scripts/"
+                        "check_memory.py --write-budget and review the "
+                        "numbers; docs/ANALYSIS.md XF014)"
+                    ),
+                )
+                continue
+            for family, est in sorted(per_family.items()):
+                allowed = entry.get(family)
+                if allowed is None:
+                    yield Finding(
+                        rule=self.id, path=rel, line=lineno,
+                        message=(
+                            f"jit entry {key} has no budget for model "
+                            f"family {family!r} (estimate "
+                            f"{est['bytes']} B at the north-star "
+                            "geometry)"
+                        ),
+                    )
+                elif est["bytes"] > int(allowed):
+                    top = est["sites"][0] if est["sites"] else None
+                    where = (
+                        f"; largest: {top['shape']} {top['kind']} at "
+                        f"{top['path']}:{top['line']}"
+                        if top
+                        else ""
+                    )
+                    yield Finding(
+                        rule=self.id, path=rel, line=lineno,
+                        message=(
+                            f"jit entry {key} transient estimate "
+                            f"{est['bytes']} B exceeds the committed "
+                            f"budget {int(allowed)} B for family "
+                            f"{family!r} at T=2^28{where} — route the "
+                            "new transient through the touched-rows "
+                            "path or deliberately raise the budget "
+                            "(docs/ANALYSIS.md XF014 policy)"
+                        ),
+                    )
+            # stale families: a numeric budget line for a family the
+            # geometry no longer declares is dead weight that would
+            # silently re-arm if the family name ever returns
+            for family in sorted(entry):
+                if family in per_family or not isinstance(
+                    entry[family], (int, float)
+                ):
+                    continue  # live family, or a comment field
+                yield Finding(
+                    rule=self.id, path=DEFAULT_BUDGET, line=0,
+                    message=(
+                        f"stale budget family {family!r} under {key} "
+                        "matches no geometry family — delete it"
+                    ),
+                )
+        # stale entries: a budget line matching no live jit silently
+        # grandfathers a future regression under the same key
+        for key in sorted(budgets):
+            if key not in estimates:
+                yield Finding(
+                    rule=self.id, path=DEFAULT_BUDGET, line=0,
+                    message=(
+                        f"stale budget entry {key} matches no jit "
+                        "entry in the scanned tree — delete it"
+                    ),
+                )
+
+
+__all__ = [
+    "DEFAULT_BUDGET",
+    "NARROW_HELPER",
+    "DonationSafety",
+    "DtypeDiscipline",
+    "FullTableTransient",
+    "ShardingCoverage",
+    "TransientBudget",
+    "estimate_transients",
+    "find_budget",
+    "load_budget",
+    "memory_context",
+    "seed_param",
+    "seed_self_attr",
+]

@@ -1,0 +1,1353 @@
+"""Symbolic shape/dtype dataflow — the abstract interpreter under the
+XF010–XF014 memory rules (rules_memory.py).
+
+The concurrency rules (PR 6) mechanized "who runs on which thread";
+this module mechanizes "how big is that array".  It walks jitted
+functions — discovered package-wide the way XF002 finds traced code —
+and propagates SYMBOLIC shapes through assignments, ``jnp``/``np``
+calls, dict/tuple plumbing, ``lax.scan`` bodies, and resolvable
+in-package call edges (riding PR 6's ``ConcurrencyContext`` for call
+resolution).  Dims are expressions over named symbols seeded from
+``Config`` caps:
+
+    T  table rows (cfg.table_size)      H  hot head rows (cfg.hot_size)
+    B  batch_size                       K  max_nnz       Kh hot_nnz
+    S  microbatch                       D  table row width (flagship)
+
+so ``zeros_like(state["tables"][n]["param"])`` is known to allocate
+``[T, D]`` and ``t["param"][batch["keys"]]`` to gather ``[B, K, D]`` —
+the facts XF010 (full-table transients), XF012 (sharding coverage) and
+XF014 (the transient-HBM budget, evaluated at the north-star geometry
+T=2^28) gate on.
+
+Design constraints, inherited from core.py: pure stdlib ``ast`` — the
+interpreter never imports or executes the code under analysis.  It is
+deliberately CONSERVATIVE: anything it cannot model becomes UNKNOWN and
+simply contributes nothing (rules only ever fire on facts it could
+prove), branches are both taken (flow-insensitive: an allocation behind
+``if`` counts), loop bodies run once, and recursion/depth are bounded.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable
+
+from xflow_tpu.analysis.core import PackageIndex, SourceFile, dotted_name
+from xflow_tpu.analysis.rules_concurrency import (
+    ConcurrencyContext,
+    _Fn,
+    get_context,
+)
+from xflow_tpu.analysis.rules_jax import _is_partial_of_jit
+
+# -- symbolic dims ---------------------------------------------------------
+#
+# A dim is a nested tuple expression:  ('c', 7) const, ('s', 'T') symbol,
+# ('+'|'*'|'//'|'-'|'%', a, b) arithmetic.  Tuples give structural
+# equality and hashability for free.
+
+Dim = tuple
+
+
+def dconst(n: int) -> Dim:
+    return ("c", int(n))
+
+
+def dsym(name: str) -> Dim:
+    return ("s", name)
+
+
+def dbin(op: str, a: Dim, b: Dim) -> Dim:
+    if a[0] == "c" and b[0] == "c":
+        x, y = a[1], b[1]
+        try:
+            v = {
+                "+": x + y,
+                "-": x - y,
+                "*": x * y,
+                "//": x // y if y else 0,
+                "%": x % y if y else 0,
+            }[op]
+        except KeyError:
+            return (op, a, b)
+        return dconst(v)
+    # cheap identities keep rendered dims readable
+    if op == "*" and a == dconst(1):
+        return b
+    if op == "*" and b == dconst(1):
+        return a
+    if op == "//" and b == dconst(1):
+        return a
+    if op in ("+", "-") and b == dconst(0):
+        return a
+    if op == "+" and a == dconst(0):
+        return b
+    return (op, a, b)
+
+
+def dprod(dims: Iterable[Dim]) -> Dim:
+    out = dconst(1)
+    for d in dims:
+        out = dbin("*", out, d)
+    return out
+
+
+def deval(d: Dim, env: dict[str, int]) -> int | None:
+    """Evaluate at a concrete geometry; None when a symbol is unbound."""
+    kind = d[0]
+    if kind == "c":
+        return d[1]
+    if kind == "s":
+        return env.get(d[1])
+    a = deval(d[1], env)
+    b = deval(d[2], env)
+    if a is None or b is None:
+        return None
+    if kind == "+":
+        return a + b
+    if kind == "-":
+        return a - b
+    if kind == "*":
+        return a * b
+    if kind == "//":
+        return a // b if b else None
+    if kind == "%":
+        return a % b if b else None
+    return None
+
+
+def dstr(d: Dim) -> str:
+    kind = d[0]
+    if kind == "c":
+        return str(d[1])
+    if kind == "s":
+        return d[1]
+    return f"({dstr(d[1])}{kind}{dstr(d[2])})"
+
+
+def shape_str(shape: tuple[Dim, ...]) -> str:
+    return "[" + ", ".join(dstr(d) for d in shape) + "]"
+
+
+# -- abstract values -------------------------------------------------------
+
+
+class _Unknown:
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return "UNK"
+
+
+UNK = _Unknown()
+
+
+@dataclass(frozen=True)
+class DimV:
+    """A Python int abstracted as a symbolic dim (e.g. cfg.table_size)."""
+
+    d: Dim
+
+
+@dataclass(frozen=True)
+class StrV:
+    s: str
+
+
+@dataclass(frozen=True)
+class ShapeV:
+    """Result of ``x.shape`` — a tuple of dims that indexes/slices."""
+
+    dims: tuple[Dim, ...]
+
+
+@dataclass(frozen=True)
+class ArrV:
+    """An array of known symbolic shape.  dtype is a best-effort string
+    ('float32', 'int32', 'uint8', 'bfloat16', ...; None = unknown,
+    sized as 4 bytes)."""
+
+    shape: tuple[Dim, ...]
+    dtype: str | None = None
+
+
+@dataclass
+class MapV:
+    """A dict whose values the flow tracks per known string key, with a
+    ``default`` for unknown keys (e.g. ``tables``: every value is a
+    table dict).  default may be a zero-arg callable for lazy cycles."""
+
+    known: dict[str, Any]
+    default: Any = None
+
+    def lookup(self, key: str | None) -> Any:
+        if key is not None and key in self.known:
+            return self.known[key]
+        d = self.default
+        if callable(d):
+            d = d()
+        return d if d is not None else UNK
+
+
+@dataclass(frozen=True)
+class TupV:
+    items: tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class ItemsV:
+    """``m.items()`` — carried to the for/comprehension that unpacks it."""
+
+    m: MapV
+
+
+class ConfigV:
+    """The Config object: attribute reads become dims via CONFIG_SYMS."""
+
+
+@dataclass(frozen=True)
+class FnRefV:
+    fn: _Fn
+
+
+@dataclass(frozen=True)
+class AtV:
+    arr: ArrV
+
+
+@dataclass(frozen=True)
+class AtIdxV:
+    arr: ArrV
+    idx: Any
+
+
+# Config attribute -> symbol.  table_size/hot_size are the @property
+# spellings of the *_log2 knobs (config.py).
+CONFIG_SYMS = {
+    "table_size": "T",
+    "hot_size": "H",
+    "max_nnz": "K",
+    "hot_nnz": "Kh",
+    "microbatch": "S",
+    "batch_size": "B",
+}
+
+_ALLOC_LEAVES = {"zeros", "ones", "full", "empty"}
+_ALLOC_LIKE_LEAVES = {"zeros_like", "ones_like", "full_like", "empty_like"}
+_ELEMWISE_LEAVES = {
+    "where", "maximum", "minimum", "clip", "add", "multiply", "subtract",
+    "exp", "log", "abs", "negative", "sign", "tanh", "logaddexp",
+}
+_SAMESHAPE_METHODS = {"cumsum", "sort", "argsort", "copy"}
+_REDUCE_LEAVES = {"sum", "max", "min", "mean", "prod", "all", "any"}
+
+_DTYPE_LEAVES = {
+    "float32": "float32", "float64": "float64", "bfloat16": "bfloat16",
+    "int32": "int32", "int64": "int64", "uint8": "uint8",
+    "uint16": "uint16", "uint32": "uint32", "bool_": "bool",
+    "bool": "bool",
+}
+
+DTYPE_BYTES = {
+    "float32": 4, "float64": 8, "bfloat16": 2, "float16": 2,
+    "int32": 4, "int64": 8, "uint8": 1, "uint16": 2, "uint32": 4,
+    "int8": 1, "bool": 1,
+}
+
+
+def dtype_bytes(dtype: str | None) -> int:
+    return DTYPE_BYTES.get(dtype or "", 4)
+
+
+@dataclass
+class Transient:
+    """One array materialization the flow could size: an explicit
+    allocation, a one-hot expansion, or a gather."""
+
+    sf: SourceFile
+    node: ast.AST
+    shape: tuple[Dim, ...]
+    dtype: str | None
+    kind: str  # 'alloc' | 'one_hot' | 'gather'
+
+    @property
+    def line(self) -> int:
+        return getattr(self.node, "lineno", 0)
+
+    def nbytes(self, env: dict[str, int]) -> int | None:
+        n = deval(dprod(self.shape), env)
+        return None if n is None else n * dtype_bytes(self.dtype)
+
+
+@dataclass
+class JitBinding:
+    """One discovered jit entry point: ``self.attr = jax.jit(self._f,
+    donate_argnums=...)``, ``g = jax.jit(f)`` or ``@jax.jit``."""
+
+    sf: SourceFile
+    node: ast.AST  # the binding site (Assign / FunctionDef)
+    bind_cls: str | None  # class owning the bound attr (self.attr = ...)
+    bind_name: str  # 'train' / 'step'
+    impl: _Fn | None
+    donate: tuple[int, ...]
+
+    @property
+    def key(self) -> str:
+        """Stable budget key: '<rel>::<Class.method>'."""
+        if self.impl is not None:
+            return f"{self.impl.sf.rel}::{self.impl.qualname}"
+        return f"{self.sf.rel}::{self.bind_name}"
+
+
+def _donate_spec(call: ast.Call) -> tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, int
+                    ):
+                        out.append(e.value)
+                return tuple(out)
+    return ()
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name is not None and name.rsplit(".", 1)[-1] in ("jit", "pjit")
+
+
+def discover_jit_bindings(
+    index: PackageIndex, ctx: ConcurrencyContext
+) -> list[JitBinding]:
+    """Every jit entry the package binds: decorated defs, module-level
+    ``g = jax.jit(f)``, and the TrainStep idiom ``self.train =
+    jax.jit(self._impl, ...)``.  ``jax.jit(f).lower().compile()`` AOT
+    sites and ``partial``-wrapped inits are not ENTRIES here (their
+    impl isn't a plain def reference)."""
+    out: list[JitBinding] = []
+    seen: set[int] = set()
+
+    def add(b: JitBinding) -> None:
+        if b.impl is not None:
+            if id(b.impl) in seen:
+                return
+            seen.add(id(b.impl))
+        out.append(b)
+
+    for fn in ctx.fns:
+        for dec in fn.node.decorator_list:
+            if _is_jit_name(dec) or (
+                isinstance(dec, ast.Call)
+                and (_is_jit_name(dec.func) or _is_partial_of_jit(dec))
+            ):
+                donate = (
+                    _donate_spec(dec) if isinstance(dec, ast.Call) else ()
+                )
+                add(JitBinding(fn.sf, fn.node, fn.cls, fn.name, fn, donate))
+    for sf in index.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _is_jit_name(node.value.func)
+                and node.value.args
+            ):
+                continue
+            ref = node.value.args[0]
+            impl: _Fn | None = None
+            cls: str | None = None
+            if isinstance(ref, ast.Name):
+                impl = ctx.module_fns.get((sf.rel, ref.id))
+            elif (
+                isinstance(ref, ast.Attribute)
+                and isinstance(ref.value, ast.Name)
+                and ref.value.id == "self"
+            ):
+                # find the enclosing class by locating the method that
+                # contains this assignment
+                for fn in ctx.fns:
+                    if fn.sf is sf and fn.cls is not None and any(
+                        n is node for n in ast.walk(fn.node)
+                    ):
+                        cls = fn.cls
+                        impl = ctx.methods.get((sf.rel, cls, ref.attr))
+                        break
+            if impl is None:
+                continue
+            bind_name = ""
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    bind_name = tgt.id
+                elif (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    bind_name = tgt.attr
+            add(
+                JitBinding(
+                    sf, node, cls, bind_name, impl,
+                    _donate_spec(node.value),
+                )
+            )
+    return out
+
+
+def traced_closure(ctx: ConcurrencyContext,
+                   entries: Iterable[JitBinding]) -> set[int]:
+    """id(_Fn) of every function reachable from a jit entry through
+    resolvable calls (cross-module — a superset of XF002's intra-module
+    closure), plus nested defs of traced functions (scan bodies)."""
+    traced: set[int] = set()
+    stack = [b.impl for b in entries if b.impl is not None]
+    while stack:
+        fn = stack.pop()
+        if id(fn) in traced:
+            continue
+        traced.add(id(fn))
+        stack.extend(fn.calls)
+        stack.extend(fn.children.values())
+    # children of traced fns added above only one level deep; close it
+    changed = True
+    while changed:
+        changed = False
+        for fn in ctx.fns:
+            if fn.parent is not None and id(fn.parent) in traced and (
+                id(fn) not in traced
+            ):
+                traced.add(id(fn))
+                stack = [fn]
+                while stack:
+                    f = stack.pop()
+                    for c in list(f.calls) + list(f.children.values()):
+                        if id(c) not in traced:
+                            traced.add(id(c))
+                            stack.append(c)
+                changed = True
+    return traced
+
+
+# -- the interpreter -------------------------------------------------------
+
+_MAX_DEPTH = 14
+
+
+class Interpreter:
+    """Abstract interpretation of one jit entry (and its resolvable
+    callees).  ``seed_param`` maps a parameter NAME to an abstract
+    value at the entry function only; callee parameters are bound from
+    the actual inferred call arguments."""
+
+    def __init__(
+        self,
+        ctx: ConcurrencyContext,
+        seed_param: Callable[[str], Any],
+        self_attr: Callable[[str], Any],
+    ):
+        self.ctx = ctx
+        self.seed_param = seed_param
+        self.self_attr = self_attr
+        self.transients: list[Transient] = []
+        self._stack: list[int] = []
+
+    # -- public ------------------------------------------------------------
+
+    def run(self, entry: _Fn) -> Any:
+        env: dict[str, Any] = {}
+        args = entry.node.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            if a.arg == "self":
+                env["self"] = "SELF"
+            else:
+                env[a.arg] = self.seed_param(a.arg)
+        try:
+            return self._exec_fn(entry, env)
+        except RecursionError:  # pragma: no cover - defensive
+            return UNK
+
+    # -- statements --------------------------------------------------------
+
+    def _exec_fn(self, fn: _Fn, env: dict[str, Any]) -> Any:
+        if len(self._stack) >= _MAX_DEPTH or id(fn) in self._stack:
+            return UNK
+        self._stack.append(id(fn))
+        try:
+            rets: list[Any] = []
+            self._exec_block(fn, fn.node.body, env, rets)
+            out = UNK
+            for r in rets:
+                out = join(out, r)
+            return out
+        finally:
+            self._stack.pop()
+
+    def _exec_block(self, fn: _Fn, stmts, env, rets) -> None:
+        for stmt in stmts:
+            self._exec_stmt(fn, stmt, env, rets)
+
+    def _exec_stmt(self, fn: _Fn, stmt: ast.AST, env, rets) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            child = fn.children.get(stmt.name)
+            if child is not None:
+                env[stmt.name] = FnRefV(child)
+        elif isinstance(stmt, ast.Return):
+            rets.append(
+                self.infer(fn, stmt.value, env)
+                if stmt.value is not None
+                else UNK
+            )
+        elif isinstance(stmt, ast.Assign):
+            v = self.infer(fn, stmt.value, env)
+            for tgt in stmt.targets:
+                self._bind(fn, tgt, v, env)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(fn, stmt.target, self.infer(fn, stmt.value, env), env)
+        elif isinstance(stmt, ast.AugAssign):
+            v = self._binop(
+                type(stmt.op),
+                self.infer(fn, stmt.target, env),
+                self.infer(fn, stmt.value, env),
+            )
+            self._bind(fn, stmt.target, v, env)
+        elif isinstance(stmt, ast.If):
+            self.infer(fn, stmt.test, env)
+            self._exec_block(fn, stmt.body, env, rets)
+            self._exec_block(fn, stmt.orelse, env, rets)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = self.infer(fn, stmt.iter, env)
+            self._bind_loop_target(fn, stmt.target, it, env)
+            self._exec_block(fn, stmt.body, env, rets)
+            self._exec_block(fn, stmt.orelse, env, rets)
+        elif isinstance(stmt, ast.While):
+            self.infer(fn, stmt.test, env)
+            self._exec_block(fn, stmt.body, env, rets)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.infer(fn, item.context_expr, env)
+            self._exec_block(fn, stmt.body, env, rets)
+        elif isinstance(stmt, ast.Try):
+            self._exec_block(fn, stmt.body, env, rets)
+            for h in stmt.handlers:
+                self._exec_block(fn, h.body, env, rets)
+            self._exec_block(fn, stmt.orelse, env, rets)
+            self._exec_block(fn, stmt.finalbody, env, rets)
+        elif isinstance(stmt, ast.Expr):
+            self.infer(fn, stmt.value, env)
+        # Import / Raise / Pass / Assert / Delete / Global: no flow
+
+    def _bind(self, fn: _Fn, tgt: ast.AST, v: Any, env) -> None:
+        if isinstance(tgt, ast.Name):
+            env[tgt.id] = v
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            items = None
+            if isinstance(v, TupV):
+                items = v.items
+            elif isinstance(v, ShapeV):
+                items = tuple(DimV(d) for d in v.dims)
+            for i, sub in enumerate(tgt.elts):
+                if isinstance(sub, ast.Starred):
+                    self._bind(fn, sub.value, UNK, env)
+                    continue
+                sv = (
+                    items[i]
+                    if items is not None and i < len(items)
+                    else UNK
+                )
+                self._bind(fn, sub, sv, env)
+        elif isinstance(tgt, ast.Subscript):
+            base = tgt.value
+            if isinstance(base, ast.Name):
+                m = env.get(base.id)
+                if isinstance(m, MapV):
+                    key = self._const_key(fn, tgt.slice, env)
+                    if key is not None:
+                        m.known[key] = v
+                    else:
+                        m.default = join(
+                            m.default if not callable(m.default) else UNK, v
+                        )
+        # self.attr = ... : not tracked (entry seeds cover self state)
+
+    def _bind_loop_target(self, fn: _Fn, tgt: ast.AST, it: Any, env) -> None:
+        if isinstance(it, ItemsV):
+            elem = TupV((UNK, self._map_join_values(it.m)))
+        elif isinstance(it, MapV):
+            elem = UNK  # iterating a dict yields keys
+        elif isinstance(it, TupV):
+            e = UNK
+            for x in it.items:
+                e = join(e, x)
+            elem = e
+        elif isinstance(it, ArrV) and it.shape:
+            elem = ArrV(it.shape[1:], it.dtype)
+        else:
+            elem = UNK
+        self._bind(fn, tgt, elem, env)
+
+    @staticmethod
+    def _map_join_values(m: MapV) -> Any:
+        out = m.default() if callable(m.default) else (m.default or UNK)
+        for v in m.known.values():
+            out = join(out, v)
+        return out
+
+    def _const_key(self, fn: _Fn, expr: ast.AST, env) -> str | None:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        v = self.infer(fn, expr, env)
+        if isinstance(v, StrV):
+            return v.s
+        return None
+
+    # -- expressions --------------------------------------------------------
+
+    def infer(self, fn: _Fn, expr: ast.AST | None, env) -> Any:
+        if expr is None:
+            return UNK
+        try:
+            return self._infer(fn, expr, env)
+        except RecursionError:  # pragma: no cover - defensive
+            raise
+        except Exception:  # noqa: BLE001 - arbitrary scanned code
+            return UNK
+
+    def _infer(self, fn: _Fn, expr: ast.AST, env) -> Any:
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id, UNK)
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, bool):
+                return ArrV((), "bool")
+            if isinstance(expr.value, int):
+                return DimV(dconst(expr.value))
+            if isinstance(expr.value, str):
+                return StrV(expr.value)
+            if isinstance(expr.value, float):
+                return ArrV((), None)  # scalar: broadcasts shape-free
+            return UNK
+        if isinstance(expr, ast.Attribute):
+            return self._attr(fn, expr, env)
+        if isinstance(expr, ast.Subscript):
+            return self._subscript(fn, expr, env)
+        if isinstance(expr, ast.Call):
+            return self._call(fn, expr, env)
+        if isinstance(expr, ast.BinOp):
+            return self._binop(
+                type(expr.op),
+                self._infer(fn, expr.left, env),
+                self._infer(fn, expr.right, env),
+            )
+        if isinstance(expr, ast.UnaryOp):
+            v = self._infer(fn, expr.operand, env)
+            if isinstance(expr.op, ast.USub) and isinstance(v, DimV):
+                return DimV(dbin("-", dconst(0), v.d))
+            return v if isinstance(v, ArrV) else UNK
+        if isinstance(expr, ast.Compare):
+            left = self._infer(fn, expr.left, env)
+            rights = [self._infer(fn, c, env) for c in expr.comparators]
+            ops = [left] + rights
+            if any(v is UNK for v in ops):
+                return UNK  # an unknown operand means an unknown shape
+            arrs = [v for v in ops if isinstance(v, ArrV)]
+            if arrs:
+                return ArrV(_broadcast([a.shape for a in arrs]), "bool")
+            return UNK
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return TupV(
+                tuple(self._infer(fn, e, env) for e in expr.elts)
+            )
+        if isinstance(expr, ast.Dict):
+            known: dict[str, Any] = {}
+            default: Any = None
+            for k, v in zip(expr.keys, expr.values):
+                val = self._infer(fn, v, env)
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    known[k.value] = val
+                elif k is None:  # **spread
+                    sv = val
+                    if isinstance(sv, MapV):
+                        known.update(sv.known)
+                        default = sv.default
+                else:
+                    key = self._const_key(fn, k, env)
+                    if key is not None:
+                        known[key] = val
+                    else:
+                        default = join(default, val)
+            return MapV(known, default)
+        if isinstance(expr, ast.DictComp):
+            return self._dictcomp(fn, expr, env)
+        if isinstance(expr, ast.IfExp):
+            return join(
+                self._infer(fn, expr.body, env),
+                self._infer(fn, expr.orelse, env),
+            )
+        if isinstance(expr, ast.Lambda):
+            return UNK
+        if isinstance(expr, ast.Starred):
+            return self._infer(fn, expr.value, env)
+        return UNK
+
+    def _dictcomp(self, fn: _Fn, expr: ast.DictComp, env) -> Any:
+        if len(expr.generators) != 1:
+            return UNK
+        gen = expr.generators[0]
+        it = self.infer(fn, gen.iter, env)
+        local = dict(env)
+
+        def eval_one(key_name: str | None, val: Any) -> tuple[str | None, Any]:
+            self._bind(fn, gen.target, _items_elem(key_name, val), local)
+            k = self._const_key(fn, expr.key, local)
+            return k, self.infer(fn, expr.value, local)
+
+        if isinstance(it, ItemsV):
+            m = it.m
+            known: dict[str, Any] = {}
+            for k, v in m.known.items():
+                kk, vv = eval_one(k, v)
+                known[kk if kk is not None else k] = vv
+            default = None
+            d = m.default() if callable(m.default) else m.default
+            if d is not None:
+                _, default = eval_one(None, d)
+            return MapV(known, default)
+        if isinstance(it, TupV):
+            known = {}
+            default = None
+            for item in it.items:
+                self._bind(fn, gen.target, item, local)
+                k = self._const_key(fn, expr.key, local)
+                v = self.infer(fn, expr.value, local)
+                if k is not None:
+                    known[k] = v
+                else:
+                    default = join(default, v)
+            return MapV(known, default)
+        # unknown iterable: evaluate once with UNK bindings
+        self._bind(fn, gen.target, UNK, local)
+        return MapV({}, self.infer(fn, expr.value, local))
+
+    def _attr(self, fn: _Fn, expr: ast.Attribute, env) -> Any:
+        base = self._infer(fn, expr.value, env)
+        if base == "SELF":
+            if expr.attr == "cfg":
+                return self.self_attr("cfg")
+            return self.self_attr(expr.attr)
+        if isinstance(base, ConfigV):
+            sym = CONFIG_SYMS.get(expr.attr)
+            return DimV(dsym(sym)) if sym else UNK
+        if isinstance(base, ArrV):
+            if expr.attr == "shape":
+                return ShapeV(base.shape)
+            if expr.attr == "at":
+                return AtV(base)
+            if expr.attr == "T" and len(base.shape) == 2:
+                return ArrV((base.shape[1], base.shape[0]), base.dtype)
+            return UNK
+        return UNK
+
+    def _subscript(self, fn: _Fn, expr: ast.Subscript, env) -> Any:
+        base = self._infer(fn, expr.value, env)
+        if isinstance(base, AtV):
+            return AtIdxV(base.arr, self._infer(fn, expr.slice, env))
+        if isinstance(base, MapV):
+            return base.lookup(self._const_key(fn, expr.slice, env))
+        if isinstance(base, (TupV, ShapeV)):
+            items = (
+                base.items
+                if isinstance(base, TupV)
+                else tuple(DimV(d) for d in base.dims)
+            )
+            idx = expr.slice
+            if isinstance(idx, ast.Constant) and isinstance(idx.value, int):
+                i = idx.value
+                if -len(items) <= i < len(items):
+                    return items[i]
+                return UNK
+            if isinstance(idx, ast.Slice):
+                lo = idx.lower.value if isinstance(
+                    idx.lower, ast.Constant
+                ) else None
+                hi = idx.upper.value if isinstance(
+                    idx.upper, ast.Constant
+                ) else None
+                sub = items[slice(lo, hi)]
+                if isinstance(base, ShapeV):
+                    return ShapeV(tuple(d.d for d in sub))
+                return TupV(sub)
+            return UNK
+        if isinstance(base, ArrV):
+            return self._index_arr(fn, base, expr.slice, env)
+        return UNK
+
+    def _index_arr(self, fn: _Fn, base: ArrV, idx: ast.AST, env) -> Any:
+        shape = base.shape
+        if isinstance(idx, ast.Tuple):
+            dims: list[Dim] = []
+            pos = 0
+            for el in idx.elts:
+                if isinstance(el, ast.Constant) and el.value is None:
+                    dims.append(dconst(1))
+                    continue
+                if pos >= len(shape):
+                    return UNK
+                if isinstance(el, ast.Slice):
+                    d = self._slice_dim(fn, shape[pos], el, env)
+                    if d is None:
+                        return UNK
+                    dims.append(d)
+                    pos += 1
+                    continue
+                v = self._infer(fn, el, env)
+                if isinstance(v, (DimV,)):
+                    pos += 1  # integer index drops the dim
+                    continue
+                return UNK  # advanced indexing inside a tuple: punt
+            dims.extend(shape[pos:])
+            return ArrV(tuple(dims), base.dtype)
+        if isinstance(idx, ast.Slice):
+            d = self._slice_dim(fn, shape[0] if shape else None, idx, env)
+            if d is None or not shape:
+                return UNK
+            return ArrV((d,) + shape[1:], base.dtype)
+        v = self._infer(fn, idx, env)
+        if isinstance(v, DimV):
+            return ArrV(shape[1:], base.dtype) if shape else UNK
+        if isinstance(v, ArrV):
+            # gather: idx.shape + base.shape[1:]
+            out = ArrV(v.shape + shape[1:], base.dtype)
+            self._record(fn, idx, out, "gather")
+            return out
+        return UNK
+
+    def _slice_dim(
+        self, fn: _Fn, dim0: Dim | None, sl: ast.Slice, env
+    ) -> Dim | None:
+        if sl.step is not None:
+            return None
+        lo: Dim = dconst(0)
+        if sl.lower is not None:
+            v = self._infer(fn, sl.lower, env)
+            if not isinstance(v, DimV):
+                return None
+            lo = v.d
+        if sl.upper is None:
+            if dim0 is None:
+                return None
+            return dbin("-", dim0, lo)
+        v = self._infer(fn, sl.upper, env)
+        if not isinstance(v, DimV):
+            return None
+        return dbin("-", v.d, lo)
+
+    def _binop(self, op: type, left: Any, right: Any) -> Any:
+        ops = {
+            ast.Add: "+", ast.Sub: "-", ast.Mult: "*",
+            ast.FloorDiv: "//", ast.Mod: "%",
+        }
+        if isinstance(left, DimV) and isinstance(right, DimV):
+            sym = ops.get(op)
+            if sym is not None:
+                return DimV(dbin(sym, left.d, right.d))
+            return UNK
+        # tuple concat: (a, b) + shape[1:]
+        if op is ast.Add and isinstance(left, (TupV, ShapeV)) and isinstance(
+            right, (TupV, ShapeV)
+        ):
+            def as_items(v):
+                return (
+                    v.items
+                    if isinstance(v, TupV)
+                    else tuple(DimV(d) for d in v.dims)
+                )
+
+            return TupV(as_items(left) + as_items(right))
+        if left is UNK or right is UNK:
+            return UNK  # an unknown operand means an unknown shape
+        arrs = [v for v in (left, right) if isinstance(v, ArrV)]
+        if arrs:
+            dtype = arrs[0].dtype
+            return ArrV(_broadcast([a.shape for a in arrs]), dtype)
+        return UNK
+
+    # -- calls --------------------------------------------------------------
+
+    def _call(self, fn: _Fn, call: ast.Call, env) -> Any:
+        func = call.func
+        # in-package resolution first: a local FnRef (scan body), then
+        # the ConcurrencyContext resolver (self.m / module f / mod.f)
+        callee: _Fn | None = None
+        if isinstance(func, ast.Name) and isinstance(
+            env.get(func.id), FnRefV
+        ):
+            callee = env[func.id].fn
+        if callee is None:
+            callee = self.ctx._resolve_call(fn, fn.sf, call)
+        if callee is not None and callee.name != "__init__":
+            return self._interproc(fn, callee, call, env)
+
+        name = dotted_name(func)
+        leaf = (
+            name.rsplit(".", 1)[-1]
+            if name
+            else (func.attr if isinstance(func, ast.Attribute) else None)
+        )
+        if leaf is None:
+            return UNK
+        if leaf == "scan":
+            return self._scan(fn, call, env)
+        if leaf in _ALLOC_LEAVES:
+            return self._alloc(fn, call, env)
+        if leaf in _ALLOC_LIKE_LEAVES:
+            src = self.infer(fn, call.args[0], env) if call.args else UNK
+            if isinstance(src, ArrV):
+                out = ArrV(src.shape, src.dtype)
+                self._record(fn, call, out, "alloc")
+                return out
+            return UNK
+        if leaf == "one_hot":
+            x = self.infer(fn, call.args[0], env) if call.args else UNK
+            n = (
+                self.infer(fn, call.args[1], env)
+                if len(call.args) > 1
+                else UNK
+            )
+            if isinstance(x, ArrV) and isinstance(n, DimV):
+                out = ArrV(x.shape + (n.d,), self._dtype_kw(fn, call, env))
+                self._record(fn, call, out, "one_hot")
+                return out
+            return UNK
+        if leaf in _DTYPE_LEAVES and call.args:
+            # jnp.int32(x) scalar casts: shape-free, broadcast-neutral
+            v = self.infer(fn, call.args[0], env)
+            if isinstance(v, ArrV):
+                return ArrV(v.shape, _DTYPE_LEAVES[leaf])
+            return ArrV((), _DTYPE_LEAVES[leaf])
+        if leaf == "arange":
+            n = self.infer(fn, call.args[0], env) if call.args else UNK
+            if isinstance(n, DimV):
+                return ArrV((n.d,), "int32")
+            return UNK
+        if leaf in ("concatenate", "stack"):
+            return self._concat(fn, call, env, stacked=leaf == "stack")
+        if leaf == "reshape" and name is not None:
+            # jnp.reshape(x, shape)
+            if len(call.args) >= 2:
+                x = self.infer(fn, call.args[0], env)
+                return self._reshape(fn, x, [call.args[1]], env)
+            return UNK
+        if leaf == "segment_sum":
+            data = self.infer(fn, call.args[0], env) if call.args else UNK
+            nseg = None
+            for kw in call.keywords:
+                if kw.arg == "num_segments":
+                    nseg = self.infer(fn, kw.value, env)
+            if len(call.args) > 2 and nseg is None:
+                nseg = self.infer(fn, call.args[2], env)
+            if isinstance(data, ArrV) and isinstance(nseg, DimV):
+                out = ArrV((nseg.d,) + data.shape[1:], data.dtype)
+                self._record(fn, call, out, "alloc")
+                return out
+            return UNK
+        if leaf in _ELEMWISE_LEAVES:
+            vals = [self.infer(fn, a, env) for a in call.args]
+            if any(v is UNK for v in vals):
+                return UNK  # an unknown operand means an unknown shape
+            arrs = [v for v in vals if isinstance(v, ArrV)]
+            if arrs:
+                return ArrV(_broadcast([a.shape for a in arrs]), arrs[0].dtype)
+            return UNK
+        if leaf in _REDUCE_LEAVES and name is not None and name.split(
+            ".", 1
+        )[0] in ("jnp", "np", "numpy", "jax"):
+            x = self.infer(fn, call.args[0], env) if call.args else UNK
+            if isinstance(x, ArrV):
+                for kw in call.keywords:
+                    if kw.arg == "axis":
+                        ax = kw.value
+                        if isinstance(ax, ast.Constant) and isinstance(
+                            ax.value, int
+                        ) and x.shape:
+                            s = list(x.shape)
+                            if -len(s) <= ax.value < len(s):
+                                s.pop(ax.value)
+                                return ArrV(tuple(s), x.dtype)
+                        return UNK
+                return ArrV((), x.dtype)
+            return UNK
+        if leaf in ("cumsum", "take", "asarray", "argsort"):
+            x = self.infer(fn, call.args[0], env) if call.args else UNK
+            if leaf == "take" and isinstance(x, ArrV) and len(call.args) > 1:
+                idx = self.infer(fn, call.args[1], env)
+                if isinstance(idx, ArrV):
+                    axis0 = not any(
+                        kw.arg == "axis" and not (
+                            isinstance(kw.value, ast.Constant)
+                            and kw.value.value == 0
+                        )
+                        for kw in call.keywords
+                    )
+                    if axis0:
+                        out = ArrV(idx.shape + x.shape[1:], x.dtype)
+                        self._record(fn, call, out, "gather")
+                        return out
+                return UNK
+            return x if isinstance(x, ArrV) else UNK
+        # method calls x.m(...)
+        if isinstance(func, ast.Attribute):
+            return self._method(fn, func, call, env)
+        return UNK
+
+    def _method(
+        self, fn: _Fn, func: ast.Attribute, call: ast.Call, env
+    ) -> Any:
+        recv = self._infer(fn, func.value, env)
+        m = func.attr
+        if isinstance(recv, MapV):
+            if m == "items":
+                return ItemsV(recv)
+            if m in ("pop", "get") and call.args:
+                return recv.lookup(self._const_key(fn, call.args[0], env))
+            if m in ("keys", "values"):
+                return UNK
+            return UNK
+        if isinstance(recv, AtIdxV):
+            if m in ("add", "set", "mul", "min", "max", "apply"):
+                return recv.arr
+            if m == "get":
+                if isinstance(recv.idx, ArrV):
+                    out = ArrV(
+                        recv.idx.shape + recv.arr.shape[1:], recv.arr.dtype
+                    )
+                    self._record(fn, call, out, "gather")
+                    return out
+                return UNK
+            return UNK
+        if isinstance(recv, ArrV):
+            if m == "reshape":
+                return self._reshape(fn, recv, call.args, env)
+            if m == "astype":
+                dt = self._dtype_of(fn, call.args[0], env) if call.args else None
+                return ArrV(recv.shape, dt or recv.dtype)
+            if m == "swapaxes" and len(call.args) == 2:
+                a, b = (
+                    self.infer(fn, call.args[0], env),
+                    self.infer(fn, call.args[1], env),
+                )
+                if (
+                    isinstance(a, DimV) and a.d[0] == "c"
+                    and isinstance(b, DimV) and b.d[0] == "c"
+                ):
+                    i, j = a.d[1], b.d[1]
+                    s = list(recv.shape)
+                    if 0 <= i < len(s) and 0 <= j < len(s):
+                        s[i], s[j] = s[j], s[i]
+                        return ArrV(tuple(s), recv.dtype)
+                return UNK
+            if m == "transpose":
+                return UNK
+            if m in _SAMESHAPE_METHODS:
+                return recv
+            if m in _REDUCE_LEAVES:
+                return ArrV((), recv.dtype)
+            return UNK
+        return UNK
+
+    def _reshape(self, fn: _Fn, x: Any, args: list, env) -> Any:
+        if not isinstance(x, ArrV):
+            return UNK
+        dim_exprs: list[Any]
+        if len(args) == 1:
+            v = self.infer(fn, args[0], env)
+            if isinstance(v, (TupV, ShapeV)):
+                dim_exprs = list(
+                    v.items
+                    if isinstance(v, TupV)
+                    else tuple(DimV(d) for d in v.dims)
+                )
+            elif isinstance(v, DimV):
+                dim_exprs = [v]
+            else:
+                return UNK
+        else:
+            dim_exprs = [self.infer(fn, a, env) for a in args]
+        dims: list[Dim | None] = []
+        minus_one_at = None
+        for i, v in enumerate(dim_exprs):
+            if isinstance(v, DimV):
+                if v.d == dconst(-1):
+                    minus_one_at = i
+                    dims.append(None)
+                else:
+                    dims.append(v.d)
+            else:
+                return UNK
+        total = dprod(x.shape)
+        if minus_one_at is not None:
+            known = dprod(d for d in dims if d is not None)
+            dims[minus_one_at] = dbin("//", total, known)
+        return ArrV(tuple(d for d in dims if d is not None), x.dtype)
+
+    def _concat(self, fn: _Fn, call: ast.Call, env, stacked: bool) -> Any:
+        if not call.args:
+            return UNK
+        seq = self.infer(fn, call.args[0], env)
+        if not isinstance(seq, TupV):
+            return UNK
+        arrs = [v for v in seq.items if isinstance(v, ArrV)]
+        if len(arrs) != len(seq.items) or not arrs:
+            return UNK
+        axis = 0
+        for kw in call.keywords:
+            if kw.arg == "axis" and isinstance(
+                kw.value, ast.Constant
+            ) and isinstance(kw.value.value, int):
+                axis = kw.value.value
+        if len(call.args) > 1 and isinstance(
+            call.args[1], ast.Constant
+        ) and isinstance(call.args[1].value, int):
+            axis = call.args[1].value
+        base = arrs[0].shape
+        if stacked:
+            out_shape = (
+                base[:axis] + (dconst(len(arrs)),) + base[axis:]
+            )
+            return ArrV(out_shape, arrs[0].dtype)
+        if not all(len(a.shape) == len(base) for a in arrs):
+            return UNK
+        if axis < 0:
+            axis += len(base)
+        if not 0 <= axis < len(base):
+            return UNK
+        cat = arrs[0].shape[axis]
+        for a in arrs[1:]:
+            cat = dbin("+", cat, a.shape[axis])
+        return ArrV(
+            base[:axis] + (cat,) + base[axis + 1:], arrs[0].dtype
+        )
+
+    def _scan(self, fn: _Fn, call: ast.Call, env) -> Any:
+        """jax.lax.scan(body, init, xs): analyze the body with
+        carry=init and x = xs stripped of its leading (slice) axis."""
+        if len(call.args) < 2:
+            return UNK
+        body_v = self.infer(fn, call.args[0], env)
+        init = self.infer(fn, call.args[1], env)
+        xs = self.infer(fn, call.args[2], env) if len(call.args) > 2 else UNK
+        if not isinstance(body_v, FnRefV):
+            return UNK
+        body = body_v.fn
+        benv = dict(env)
+        args = body.node.args
+        names = [a.arg for a in args.posonlyargs + args.args]
+        if len(names) >= 1:
+            benv[names[0]] = init
+        if len(names) >= 2:
+            benv[names[1]] = strip_leading(xs)
+        ret = self._exec_fn(body, benv)
+        if isinstance(ret, TupV) and len(ret.items) == 2:
+            return TupV((ret.items[0], UNK))
+        return UNK
+
+    def _interproc(self, fn: _Fn, callee: _Fn, call: ast.Call, env) -> Any:
+        cenv: dict[str, Any] = {}
+        args = callee.node.args
+        params = [a.arg for a in args.posonlyargs + args.args]
+        if params and params[0] == "self":
+            cenv["self"] = "SELF"
+            params = params[1:]
+        vals = [self.infer(fn, a, env) for a in call.args]
+        for p, v in zip(params, vals):
+            cenv[p] = v
+        for kw in call.keywords:
+            if kw.arg is not None and (
+                kw.arg in params
+                or kw.arg in [a.arg for a in args.kwonlyargs]
+            ):
+                cenv[kw.arg] = self.infer(fn, kw.value, env)
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            cenv.setdefault(a.arg, UNK)
+        return self._exec_fn(callee, cenv)
+
+    # -- misc ---------------------------------------------------------------
+
+    def _dtype_kw(self, fn: _Fn, call: ast.Call, env) -> str | None:
+        for kw in call.keywords:
+            if kw.arg == "dtype":
+                return self._dtype_of(fn, kw.value, env)
+        return None
+
+    def _dtype_of(self, fn: _Fn, expr: ast.AST, env) -> str | None:
+        name = dotted_name(expr)
+        if name is not None:
+            leaf = name.rsplit(".", 1)[-1]
+            return _DTYPE_LEAVES.get(leaf)
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        return None
+
+    def _alloc(self, fn: _Fn, call: ast.Call, env) -> Any:
+        if not call.args:
+            return UNK
+        shape_v = self.infer(fn, call.args[0], env)
+        dims: tuple[Dim, ...] | None = None
+        if isinstance(shape_v, DimV):
+            dims = (shape_v.d,)
+        elif isinstance(shape_v, (TupV, ShapeV)):
+            items = (
+                shape_v.items
+                if isinstance(shape_v, TupV)
+                else tuple(DimV(d) for d in shape_v.dims)
+            )
+            if all(isinstance(i, DimV) for i in items):
+                dims = tuple(i.d for i in items)
+        if dims is None:
+            return UNK
+        dt = self._dtype_kw(fn, call, env)
+        if dt is None and len(call.args) > 1:
+            dt = self._dtype_of(fn, call.args[1], env)
+        if dt is None and len(call.args) > 2:  # full(shape, fill, dtype)
+            dt = self._dtype_of(fn, call.args[2], env)
+        out = ArrV(dims, dt)
+        self._record(fn, call, out, "alloc")
+        return out
+
+    def _record(self, fn: _Fn, node: ast.AST, arr: ArrV, kind: str) -> None:
+        self.transients.append(
+            Transient(fn.sf, node, arr.shape, arr.dtype, kind)
+        )
+
+
+def _items_elem(key: str | None, val: Any) -> TupV:
+    return TupV((StrV(key) if key is not None else UNK, val))
+
+
+def strip_leading(v: Any) -> Any:
+    """The per-iteration element of a scanned/stacked value: every array
+    loses its leading axis."""
+    if isinstance(v, ArrV) and v.shape:
+        return ArrV(v.shape[1:], v.dtype)
+    if isinstance(v, MapV):
+        return MapV(
+            {k: strip_leading(x) for k, x in v.known.items()},
+            strip_leading(v.default() if callable(v.default) else v.default)
+            if v.default is not None
+            else None,
+        )
+    if isinstance(v, TupV):
+        return TupV(tuple(strip_leading(x) for x in v.items))
+    return UNK
+
+
+def join(a: Any, b: Any) -> Any:
+    """Best-effort join: prefer the known side; per-key for maps (an
+    ``_expand_wire`` that returns the input batch on one path and a
+    rebuilt dict on another keeps the seeded plane shapes)."""
+    if a is UNK or a is None:
+        return b
+    if b is UNK or b is None:
+        return a
+    if isinstance(a, MapV) and isinstance(b, MapV):
+        known = dict(a.known)
+        for k, v in b.known.items():
+            known[k] = join(known.get(k, UNK), v)
+        ad = a.default() if callable(a.default) else a.default
+        bd = b.default() if callable(b.default) else b.default
+        return MapV(known, join(ad, bd) if (ad or bd) else None)
+    if isinstance(a, TupV) and isinstance(b, TupV) and len(a.items) == len(
+        b.items
+    ):
+        return TupV(
+            tuple(join(x, y) for x, y in zip(a.items, b.items))
+        )
+    return a
+
+
+def _broadcast(shapes: list[tuple[Dim, ...]]) -> tuple[Dim, ...]:
+    """Right-aligned broadcast; on symbolic disagreement the first
+    non-1 dim wins (heuristic — sizes, not correctness, are at stake)."""
+    rank = max(len(s) for s in shapes)
+    out: list[Dim] = []
+    for i in range(rank):
+        dim = dconst(1)
+        for s in shapes:
+            j = i - (rank - len(s))
+            if j < 0:
+                continue
+            d = s[j]
+            if d == dconst(1):
+                continue
+            if dim == dconst(1):
+                dim = d
+        out.append(dim)
+    return tuple(out)
+
+
+# -- memory context (cached per index, like ConcurrencyContext) ------------
+
+
+class MemoryContext:
+    """Jit entries + per-entry transient flows, computed once and shared
+    by XF010/XF011/XF013/XF014."""
+
+    def __init__(
+        self,
+        index: PackageIndex,
+        seed_param: Callable[[str], Any],
+        self_attr: Callable[[str], Any],
+    ):
+        self.index = index
+        self.ctx = get_context(index)
+        self.bindings = discover_jit_bindings(index, self.ctx)
+        self.traced = traced_closure(self.ctx, self.bindings)
+        self.flows: dict[str, list[Transient]] = {}
+        for b in self.bindings:
+            if b.impl is None:
+                continue
+            interp = Interpreter(self.ctx, seed_param, self_attr)
+            try:
+                interp.run(b.impl)
+            except Exception:  # noqa: BLE001 - never crash the pass
+                continue
+            # dedupe by site within one entry (loops/branches revisit
+            # the same node); col_offset keeps two same-shape
+            # allocations on ONE source line distinct — dropping one
+            # would under-count the XF014 upper bound
+            seen: set[tuple[str, int, int, str]] = set()
+            uniq: list[Transient] = []
+            for t in interp.transients:
+                key = (
+                    t.sf.rel,
+                    t.line,
+                    getattr(t.node, "col_offset", 0),
+                    shape_str(t.shape),
+                )
+                if key not in seen:
+                    seen.add(key)
+                    uniq.append(t)
+            self.flows[b.key] = uniq
+
+
+def get_memory_context(
+    index: PackageIndex,
+    seed_param: Callable[[str], Any],
+    self_attr: Callable[[str], Any],
+) -> MemoryContext:
+    # keyed by the seed functions: a caller with DIFFERENT seeds must
+    # not silently receive flows computed under someone else's
+    cache: dict = getattr(index, "_memory_ctx", None)
+    if cache is None:
+        cache = {}
+        index._memory_ctx = cache
+    key = (id(seed_param), id(self_attr))
+    ctx = cache.get(key)
+    if ctx is None:
+        ctx = MemoryContext(index, seed_param, self_attr)
+        cache[key] = ctx
+    return ctx

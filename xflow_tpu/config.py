@@ -232,6 +232,24 @@ class Config:
     #   into device-rate wall-clock (docs/PERF.md "Sequential mode").
     sequential_inner: str = "dense"  # {"dense", "sparse", "hot"}
 
+    # Window-end update form for sequential_inner='hot' (the cold-tail
+    # pass that closes each dispatch window):
+    # "dense" — accumulate cold grads into a [T, D] buffer and run ONE
+    #   full-table optimizer pass (g=0 rows idempotent).  Simple, and
+    #   fine at T<=2^24 — but the buffer + pass are a full-table
+    #   transient per table per dispatch, multi-GB at T=2^28 for D>1
+    #   (the ADVICE step.py:945 hazard; analysis rule XF010/XF014).
+    # "sparse" — consolidate the window's cold keys (one argsort +
+    #   segment-sum, ops/sparse.py) and gather/update/scatter ONLY
+    #   touched rows: O(window nnz) work and transients, table-size-
+    #   independent — the north-star form.  Same training: one summed-
+    #   gradient update per touched row either way
+    #   (tests/test_sequential.py).
+    # "auto" (default): "sparse" from table_size_log2 >= 24 up (where
+    #   the [T, D] transient would exceed ~any per-table budget),
+    #   "dense" below.
+    hot_windowend: str = "auto"  # {"auto", "dense", "sparse"}
+
     # Gradient-accumulation slices per train step (1 = off).  The batch
     # is split into `microbatch` equal slices scanned sequentially;
     # per-slice gradients accumulate into the dense per-table buffers
@@ -364,6 +382,10 @@ class Config:
                 "sequential_inner='hot' needs a hot table "
                 "(hot_size_log2 > 0) — the per-slice update IS the "
                 "hot head"
+            )
+        if self.hot_windowend not in ("auto", "dense", "sparse"):
+            raise ValueError(
+                f"unknown hot_windowend {self.hot_windowend!r}"
             )
         if self.cold_consolidate and self.update_mode not in (
             "dense",

@@ -18,6 +18,34 @@ import dataclasses
 import numpy as np
 
 
+def narrow_keys_i32(keys: np.ndarray) -> np.ndarray:
+    """THE sanctioned uint64→int32 key narrowing (analysis rule XF011).
+
+    Batch key planes are int32 (XLA gather/scatter indices), but the
+    feature key space is uint64 (hashed fids, io/hashing.py) — every
+    narrowing is only safe AFTER reduction mod ``table_size``
+    (table_size_log2 <= 30, config.py).  Ad-hoc ``.astype(np.int32)``
+    casts scattered through the host path would silently WRAP if a
+    future table-size bump (or an unreduced 64-bit key) ever reached
+    one; this helper is the single audited choke point: already-int32
+    input passes through free, anything wider is range-checked before
+    the cast (the same reject-never-wrap contract as pack_batch and
+    the native parser's -2 return).
+    """
+    a = np.asarray(keys)
+    if a.dtype == np.int32:
+        return a
+    if a.size and (
+        int(a.min()) < np.iinfo(np.int32).min
+        or int(a.max()) > np.iinfo(np.int32).max
+    ):
+        raise ValueError(
+            "narrow_keys_i32: key exceeds int32 — reduce full 64-bit "
+            "keys mod table_size before narrowing (reject, never wrap)"
+        )
+    return a.astype(np.int32)
+
+
 @dataclasses.dataclass
 class Batch:
     keys: np.ndarray  # int32 [B, K] — row index into the hashed weight table
@@ -180,7 +208,7 @@ def remap_batch(
     slots = np.concatenate([batch.hot_slots, batch.slots, pad_i], axis=1)
     vals = np.concatenate([batch.hot_vals, batch.vals, pad_f], axis=1)
     mask = np.concatenate([batch.hot_mask, batch.mask, pad_f], axis=1)
-    keys = np.where(mask > 0, remap[keys], 0).astype(np.int32)
+    keys = narrow_keys_i32(np.where(mask > 0, remap[keys], 0))
     return make_batch(
         keys, slots, vals, mask, batch.labels, batch.weights,
         hot_size, hot_nnz,

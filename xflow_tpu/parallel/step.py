@@ -143,8 +143,17 @@ def compact_wire_np(
     init) a clamped slot lands in the ignored range either way."""
     import numpy as np
 
+    from xflow_tpu.io.batch import narrow_keys_i32
+
     def sentinel(keys, mask):
-        return np.where(mask > 0, keys, np.int32(-1)).astype(np.int32)
+        # narrow THROUGH the audited choke point (XF011): loader-built
+        # batches are int32 already (free pass-through); an external
+        # 64-bit-key batch is range-checked, never wrapped.  Masked
+        # lanes are zeroed in the WIDE dtype first — padding may carry
+        # unreduced garbage, and only live keys owe the range contract
+        # — then the -1 sentinel is applied in int32 space.
+        live = narrow_keys_i32(np.where(mask > 0, keys, 0))
+        return np.where(mask > 0, live, np.int32(-1))
 
     def slots_u8(slots):
         # anything outside [0, 255] maps to 255 (>= max_fields → the
@@ -242,7 +251,14 @@ class TrainStep:
         # Per-table MXU hot opt-out (TableSpec.hot): opted-out tables
         # keep their hot-plane occurrences on plain DMA gather/scatter.
         self._mxu_hot = {spec.name: spec.hot for spec in model.tables()}
-        if cfg.sequential_inner == "hot" and not all(
+        # The hot-inner/opt-out conflict only exists when the hot inner
+        # actually RUNS — update_mode must be 'sequential'.  In dense or
+        # sparse mode sequential_inner is an unused knob (ffm + dense +
+        # inner='hot' is a legal Config), so rejecting it at build was a
+        # false failure (ADVICE round-5 low #2).
+        if cfg.update_mode == "sequential" and (
+            cfg.sequential_inner == "hot"
+        ) and not all(
             self._mxu_hot.values()
         ):
             opted_out = [n for n, v in self._mxu_hot.items() if not v]
@@ -272,6 +288,16 @@ class TrainStep:
             cfg.hot_impl
             if cfg.hot_impl != "auto"
             else ("mxu" if platform == "tpu" else "seg")
+        )
+        # Window-end form for the hot sequential inner
+        # (Config.hot_windowend): the dense [T, D] cold-tail pass is
+        # fine while tables are small; from table_size_log2 >= 24 the
+        # transient would dwarf the update itself, so auto routes
+        # through the consolidated touched-rows update.
+        self._windowend = (
+            cfg.hot_windowend
+            if cfg.hot_windowend != "auto"
+            else ("sparse" if cfg.table_size_log2 >= 24 else "dense")
         )
         # Dictionary-wire eligibility (Config.wire_dedup; io/compact.py):
         # host-side batch compaction needs the compact-wire invariants
@@ -878,7 +904,13 @@ class TrainStep:
 
         # sequential with one slice degenerates to a single whole-batch
         # update; honor the configured inner so a sparse-inner run at
-        # microbatch=1 doesn't silently pay a full-table dense pass
+        # microbatch=1 doesn't silently pay a full-table dense pass.
+        # The 'hot' inner deliberately does NOT route here: with one
+        # slice its dispatch window IS the whole batch (per-slice head
+        # update + window-end tail collapse into one whole-batch
+        # update), so it falls through to the dense accumulate path
+        # below — the explicit degenerate form, equivalence pinned by
+        # tests/test_sequential.py::test_sequential_microbatch_one_is_dense.
         if cfg.update_mode == "sparse" or (
             cfg.update_mode == "sequential"
             and cfg.sequential_inner == "sparse"
@@ -901,6 +933,9 @@ class TrainStep:
         # no row gather/scatter.  Untouched rows see g=0, for which
         # FTRL/SGD are idempotent (optim docstrings).
         gbufs = {
+            # the [T, D] buffer IS dense mode's design (small-table
+            # form; 'sparse' is the 2^28 form) — budgeted in
+            # memory-budget.json, justified here (xf: ignore[XF010])
             name: jnp.zeros_like(t["param"]) for name, t in tables.items()
         }
         s = cfg.microbatch
@@ -964,6 +999,21 @@ class TrainStep:
             batch["hot_keys"],
             jnp.int32(self.cfg.hot_size),
         ).reshape(-1)
+
+    def _apply_touched_rows(
+        self, table: dict, ukeys: jax.Array, gsum: jax.Array
+    ) -> dict:
+        """Gather state rows at the consolidated unique keys, run the
+        optimizer recurrence, scatter the new rows back (sentinel keys
+        clamp on gather and drop on scatter — ops/sparse.py).  The ONE
+        touched-rows application, shared by _sparse_update (both the
+        MXU and opted-out variants) and the hot inner's sparse
+        window-end so the three cannot drift."""
+        state_rows = {k: gather_rows(arr, ukeys) for k, arr in table.items()}
+        new_rows = self.optimizer.update_rows(state_rows, gsum)
+        return {
+            k: scatter_rows(table[k], ukeys, new_rows[k]) for k in table
+        }
 
     def _sparse_update(
         self, tables: dict, batch: BatchArrays, occ_grads: dict
@@ -1033,24 +1083,12 @@ class TrainStep:
                     order_a,
                     seg_a,
                 )
-                state_rows = {
-                    k: gather_rows(arr, ukeys_a) for k, arr in table.items()
-                }
-                new_rows = self.optimizer.update_rows(state_rows, gsum_a)
-                new_tables[name] = {
-                    k: scatter_rows(table[k], ukeys_a, new_rows[k])
-                    for k in table.keys()
-                }
+                new_tables[name] = self._apply_touched_rows(
+                    table, ukeys_a, gsum_a
+                )
                 continue
             gsum = consolidate_apply(occ.reshape(-1, d), order, seg)
-            state_rows = {
-                k: gather_rows(arr, ukeys_cold) for k, arr in table.items()
-            }
-            new_rows = self.optimizer.update_rows(state_rows, gsum)
-            new = {
-                k: scatter_rows(table[k], ukeys_cold, new_rows[k])
-                for k in table.keys()
-            }
+            new = self._apply_touched_rows(table, ukeys_cold, gsum)
             if kh:
                 ghot = hot_scatter(
                     hot_keys_eff, hot_g, hsize,
@@ -1115,6 +1153,10 @@ class TrainStep:
                 new_tables = self._sparse_update(tables_c, bslice, occ_s)
             else:
                 gbufs = {
+                    # dense inner: full-table pass per slice BY CHOICE
+                    # (config.sequential_inner documents the cost; the
+                    # sparse/hot inners are the 2^28 forms) — budgeted
+                    # in memory-budget.json (xf: ignore[XF010])
                     name: jnp.zeros_like(t["param"])
                     for name, t in tables_c.items()
                 }
@@ -1256,14 +1298,19 @@ class TrainStep:
             body, (heads0, dense, zero, zero), xs
         )
         # Close the window: write the evolved head back, then apply the
-        # accumulated cold-tail grads in one dense pass (g=0 rows are
-        # idempotent under FTRL/SGD — optim docstrings).  Spill grads
-        # (cold-plane keys < H) land on the written-back head rows
-        # here, exactly once.
+        # accumulated cold-tail grads — as ONE dense full-table pass
+        # (g=0 rows are idempotent under FTRL/SGD — optim docstrings),
+        # or, with Config.hot_windowend='sparse' (auto at
+        # table_size_log2 >= 24), through the consolidated touched-rows
+        # update: O(window nnz) transients instead of a [T, D] buffer +
+        # full-table pass per table — the only viable form at T=2^28
+        # (ADVICE step.py:945; analysis rules XF010/XF014).  Either
+        # way, spill grads (cold-plane keys < H) land on the
+        # written-back head rows here, exactly once.
         keys_eff = self._cold_keys_eff(batch)
         plan = (
             consolidate_plan(keys_eff, cfg.table_size)
-            if cfg.cold_consolidate
+            if self._windowend == "sparse" or cfg.cold_consolidate
             else None
         )
         new_tables = {}
@@ -1279,8 +1326,27 @@ class TrainStep:
             # back to batch order (example i lives at slice i%s,
             # position i//s — _interleaved_slices)
             occ = cold_occ[name].swapaxes(0, 1).reshape(-1, d)
+            if self._windowend == "sparse":
+                # routed window-end: every table's gradients ride the
+                # one shared plan; touched rows see the same summed
+                # window gradient the dense pass would apply, pad/
+                # sentinel slots gather-clip and scatter-drop
+                # (ops/sparse.py module docstring;
+                # tests/test_sequential.py equivalence)
+                order, seg, ukeys = plan
+                gsum = consolidate_apply(occ, order, seg)
+                new_tables[name] = self._apply_touched_rows(
+                    merged, ukeys, gsum
+                )
+                continue
             gbuf = self._cold_accumulate(
-                jnp.zeros_like(table["param"]), keys_eff, occ, plan
+                # dense window-end (the small-table form; see the
+                # routed branch above for 2^28) — budgeted in
+                # memory-budget.json (xf: ignore[XF010])
+                jnp.zeros_like(table["param"]),
+                keys_eff,
+                occ,
+                plan,
             )
             new_tables[name] = self.optimizer.update_rows(merged, gbuf)
         ll = nll_sum / jnp.maximum(cnt, 1.0)

@@ -96,6 +96,15 @@ def build_parser() -> argparse.ArgumentParser:
         "dispatch window — needs --hot-size-log2)",
     )
     p.add_argument(
+        "--hot-windowend", dest="hot_windowend",
+        choices=["auto", "dense", "sparse"],
+        help="window-end cold-tail form for --sequential-inner hot: "
+        "dense = [T, D] buffer + full-table pass (small tables); "
+        "sparse = consolidated touched-rows update, table-size "
+        "independent (the 2^28 form; analysis rule XF010/XF014); "
+        "auto = sparse from --table-size-log2 24 up",
+    )
+    p.add_argument(
         "--cold-consolidate", action="store_true", default=None,
         dest="cold_consolidate",
         help="merge duplicate cold keys (shared argsort + segment-sum) "
