@@ -1,0 +1,162 @@
+"""Tiered-store smoke lint: a tiny tiered run (T=2^16, hot 2^10) must
+be indistinguishable from the dense run it replaces — and leave no
+threads behind (docs/STORE.md):
+
+* **parity** — train fm (D>1, the family the store exists for) tiered
+  and dense from the SAME logical init, export both, score both
+  through PredictEngine: predictions agree to 1e-6 (the acceptance
+  bar; in practice bitwise on CPU).  The dense run's tables are seeded
+  from the store's per-row init (store/cold.py::row_init_values) so
+  the comparison isolates the TIERING, not the init scheme.
+* **schema** — the run's ``store`` JSONL rows validate strictly
+  against obs/schema.py and the epoch-2 hot_hit_rate is sane (> 0 —
+  the toy working set fits 2^10 slots, so warm epochs should hit).
+* **thread hygiene** — after Trainer.close() no ``store-promote``
+  worker survives (the XF006 bounded-join contract, checked live).
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python scripts/check_store_smoke.py
+
+Wired into tier-1 like check_serve_smoke.py
+(tests/test_store.py::test_check_store_smoke_script).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import jax
+
+    from tests.gen_data import generate_dataset
+    from xflow_tpu.config import Config
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.parallel.mesh import table_sharding
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.store.cold import row_init_values
+    from xflow_tpu.trainer import Trainer
+
+    errors: list[str] = []
+    with tempfile.TemporaryDirectory() as root:
+        ds = generate_dataset(
+            os.path.join(root, "data"),
+            num_train_shards=2,
+            lines_per_shard=200,
+            num_fields=10,
+            vocab_per_field=8,
+            seed=7,
+            scale=3.0,
+        )
+        base = dict(
+            train_path=ds.train_prefix,
+            test_path=ds.test_prefix,
+            model="fm",
+            epochs=2,
+            batch_size=64,
+            table_size_log2=16,
+            max_nnz=24,
+            num_devices=1,
+        )
+        metrics = os.path.join(root, "store.jsonl")
+        cfg_t = Config(
+            store_mode="tiered",
+            hot_capacity_log2=10,
+            metrics_out=metrics,
+            **base,
+        )
+        cfg_d = Config(**base)
+
+        tiered = Trainer(cfg_t)
+        dense = Trainer(cfg_d)
+        # same logical starting table: seed the dense run's params from
+        # the store's deterministic per-row init
+        sharding = table_sharding(dense.mesh)
+        for spec in dense.model.tables():
+            init = row_init_values(
+                cfg_d.seed,
+                spec.name,
+                "param",
+                np.arange(cfg_d.table_size, dtype=np.int64),
+                spec.dim,
+                spec.init_kind,
+                spec.init_scale,
+            )
+            dense.state["tables"][spec.name]["param"] = jax.device_put(
+                init, sharding
+            )
+        tiered.train()
+        dense.train()
+
+        art_t = export_artifact(tiered, os.path.join(root, "art_tiered"))
+        art_d = export_artifact(dense, os.path.join(root, "art_dense"))
+        eng_t = PredictEngine.load(art_t, buckets=(64,), warm=False)
+        eng_d = PredictEngine.load(art_d, buckets=(64,), warm=False)
+        rng = np.random.default_rng(0)
+        rows = [
+            rng.integers(0, cfg_d.table_size, size=int(rng.integers(1, 12)))
+            for _ in range(128)
+        ]
+        p_t = eng_t.predict(eng_t.featurize_raw(rows))
+        p_d = eng_d.predict(eng_d.featurize_raw(rows))
+        worst = float(np.abs(p_t - p_d).max())
+        if not np.allclose(p_t, p_d, atol=1e-6):
+            errors.append(
+                f"tiered vs dense predictions diverge (max |diff| "
+                f"{worst:.2e} > 1e-6) — the tiering changed the model"
+            )
+
+        tiered.close()
+        dense.close()
+        leaked = [
+            t.name for t in threading.enumerate()
+            if t.name.startswith("store-promote") and t.is_alive()
+        ]
+        if leaked:
+            errors.append(
+                f"promotion worker thread(s) survived close(): {leaked}"
+            )
+
+        rows_jsonl = load_jsonl(metrics)
+        errors.extend(validate_rows(rows_jsonl))
+        store_rows = [r for r in rows_jsonl if r.get("kind") == "store"]
+        if len(store_rows) < 2:
+            errors.append(
+                f"tiered run emitted {len(store_rows)} store row(s), "
+                "expected one per epoch"
+            )
+        else:
+            warm = store_rows[-1]
+            if warm["hot_hit_rate"] <= 0.0:
+                errors.append(
+                    f"warm-epoch hot_hit_rate {warm['hot_hit_rate']} "
+                    "is not positive — promotion never filled the tier"
+                )
+        n = len(rows_jsonl)
+
+    for e in errors:
+        print(f"FAIL: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print(
+        f"OK: tiered/dense parity max|diff|={worst:.1e}; "
+        f"{n} metrics rows validated; warm hot_hit_rate="
+        f"{store_rows[-1]['hot_hit_rate']}; no promotion-worker leaks"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

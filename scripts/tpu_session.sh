@@ -1,81 +1,264 @@
 #!/bin/bash
-# Round-5 TPU measurement queue — run when the tunnel is healthy:
-#     bash scripts/tpu_session.sh [outdir]
+# TPU measurement queue — ALL the chip-session probe queues in one
+# parameterized script (formerly tpu_session.sh + tpu_session{2,3,4}.sh,
+# one file per round-5 re-plan; each former variant is a part here):
 #
-# Runs the full evidence list in priority order, flushing each result
-# to its own file the moment it lands (the tunnel dies without
-# warning — docs/PERF.md).  NO timeouts around TPU-bound processes:
-# killing one wedges the chip lease for every later client (verify
-# skill notes).  Priorities:
-#   1. bench.py             -> flagship artifact (BENCH + docs/artifacts)
-#   2. time_to_auc lr       -> the north-star >=5x wall-clock-to-AUC
-#   3. time_to_auc flagship -> full-protocol path-parity overlay
-#   4. probe_consolidate    -> is the argsort worth the saved slices?
-#   5. bench_models sweeps  -> D>1 hot-head scaling + cold_consolidate
-#   6. time_to_auc t28      -> B_eff=512 at the north-star table
+#     bash scripts/tpu_session.sh PART [outdir]
+#
+#   PART = r5     round-5 evidence list: flagship bench, wall-to-AUC,
+#                 probe_consolidate, D>1 hot sweeps, t28 sparse probe
+#          r5b    post-tunnel-drop re-plan: sparse-inner headline,
+#                 reference-shaped e2e ckpt/resume, D>1 sweeps, fm/mvm
+#                 wall-to-AUC (sparse inner)
+#          r5c    hot-fine/cold-coarse inner (sequential_inner='hot'):
+#                 headline crossings, half-window, t28 rate probe,
+#                 fm/mvm on the hot inner
+#          r5d    remainder of r5b after the 2026-07-31 drop: e2e
+#                 ckpt/resume, lr flagship neighbors, D>1 sweeps, ffm
+#                 per-table hot
+#          store  tiered-store (store_mode='tiered', docs/STORE.md):
+#                 D>1 families at the 2^28 north star + zipf hit-rate
+#                 and store-row evidence
+#
+# Run when the tunnel is healthy.  Results flush to their own files the
+# moment they land (the tunnel dies without warning — docs/PERF.md).
+# NO timeouts around TPU-bound processes: killing one wedges the chip
+# lease for every later client (verify skill notes).
 set -u
 cd "$(dirname "$0")/.."
-OUT="${1:-/tmp/tpu_r5}"
+PART="${1:?usage: tpu_session.sh {r5|r5b|r5c|r5d|store} [outdir]}"
+OUT="${2:-/tmp/tpu_${PART}}"
 mkdir -p "$OUT"
 log() { echo "[$(date -u +%H:%M:%S)] $*"; }
 
-log "1/6 bench.py (flagship)"
-python bench.py >"$OUT/bench.json" 2>"$OUT/bench.err"
-tail -c 400 "$OUT/bench.json"
+e2e_ckpt_resume() {
+  log "reference-shaped e2e on TPU: CLI train over the binary cache + ckpt + resume"
+  rm -rf /tmp/ck_tpu /tmp/pred_tpu.txt
+  python -m xflow_tpu.train --model lr \
+      --train /tmp/xflow_conv/bin.train --test /tmp/xflow_conv/bin.test \
+      --epochs 2 --batch-size 131072 --table-size-log2 24 --max-nnz 40 \
+      --hot-size-log2 12 --hot-nnz 32 --num-devices 1 \
+      --checkpoint-dir /tmp/ck_tpu --metrics-out "$OUT/e2e_train_metrics.jsonl" \
+      >"$OUT/e2e_train.out" 2>"$OUT/e2e_train.err"
+  tail -3 "$OUT/e2e_train.out"
+  python -m xflow_tpu.train --model lr \
+      --train /tmp/xflow_conv/bin.train --test /tmp/xflow_conv/bin.test \
+      --epochs 3 --batch-size 131072 --table-size-log2 24 --max-nnz 40 \
+      --hot-size-log2 12 --hot-nnz 32 --num-devices 1 \
+      --checkpoint-dir /tmp/ck_tpu --resume \
+      >"$OUT/e2e_resume.out" 2>"$OUT/e2e_resume.err"
+  tail -3 "$OUT/e2e_resume.out"
+}
 
-log "2/6 time_to_auc lr (plain path, the north-star artifact)"
-python scripts/time_to_auc.py --model lr \
-    >"$OUT/ttauc_lr.out" 2>"$OUT/ttauc_lr.err"
-tail -2 "$OUT/ttauc_lr.out"
+lr_flagship_neighbors() {
+  log "lr flagship neighbors (cold-nnz 12, bf16 hot)"
+  python scripts/bench_models.py --model lr --batch-log2 17 \
+      --hot-log2 12 --cold-nnz 12 \
+      >>"$OUT/lr_neighbors.out" 2>>"$OUT/lr_neighbors.err"
+  python scripts/bench_models.py --model lr --batch-log2 17 \
+      --hot-log2 12 --hot-dtype bfloat16 \
+      >>"$OUT/lr_neighbors.out" 2>>"$OUT/lr_neighbors.err"
+  tail -2 "$OUT/lr_neighbors.out"
+}
 
-log "3/6 time_to_auc lr flagship path (full-protocol overlay)"
-python scripts/time_to_auc.py --model lr \
-    --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
-    --out docs/artifacts/time_to_auc_lr_flagship.json \
-    >"$OUT/ttauc_lr_flag.out" 2>"$OUT/ttauc_lr_flag.err"
-tail -2 "$OUT/ttauc_lr_flag.out"
-
-log "4/6 probe_consolidate"
-python scripts/probe_consolidate.py \
-    >"$OUT/probe_consolidate.out" 2>"$OUT/probe_consolidate.err"
-cat "$OUT/probe_consolidate.out"
-
-log "5/6 bench_models: baseline + D>1 sweeps"
-python scripts/bench_models.py --batch-log2 17 \
-    >"$OUT/models_base.out" 2>"$OUT/models_base.err"
-for m in fm mvm wide_deep; do
-  for h in 14 15 16; do
+d1_hot_sweeps() {  # fm/mvm/wide_deep hot {15,16} + bf16
+  log "D>1 hot-head scaling: fm/mvm/wide_deep hot {15,16} + bf16"
+  for m in fm mvm wide_deep; do
+    for h in 15 16; do
+      python scripts/bench_models.py --model "$m" --batch-log2 17 \
+          --hot-log2 "$h" \
+          >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+    done
     python scripts/bench_models.py --model "$m" --batch-log2 17 \
-        --hot-log2 "$h" \
-        >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
-    python scripts/bench_models.py --model "$m" --batch-log2 17 \
-        --hot-log2 "$h" --cold-consolidate \
+        --hot-log2 14 --hot-dtype bfloat16 \
         >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
   done
-  python scripts/bench_models.py --model "$m" --batch-log2 17 \
-      --hot-log2 14 --hot-dtype bfloat16 \
+  tail -9 "$OUT/models_sweep.out"
+}
+
+ttauc_t28_sparse() {
+  log "time_to_auc t28 sparse inner (north-star table)"
+  python scripts/time_to_auc.py --model lr --table-size-log2 28 \
+      --sequential-inner sparse --max-epochs 2 --target-auc 0.99 \
+      --out docs/artifacts/time_to_auc_lr_t28.json \
+      >"$OUT/ttauc_t28.out" 2>"$OUT/ttauc_t28.err"
+  tail -2 "$OUT/ttauc_t28.out"
+}
+
+part_r5() {
+  log "1/6 bench.py (flagship)"
+  python bench.py >"$OUT/bench.json" 2>"$OUT/bench.err"
+  tail -c 400 "$OUT/bench.json"
+
+  log "2/6 time_to_auc lr (plain path, the north-star artifact)"
+  python scripts/time_to_auc.py --model lr \
+      >"$OUT/ttauc_lr.out" 2>"$OUT/ttauc_lr.err"
+  tail -2 "$OUT/ttauc_lr.out"
+
+  log "3/6 time_to_auc lr flagship path (full-protocol overlay)"
+  python scripts/time_to_auc.py --model lr \
+      --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
+      --out docs/artifacts/time_to_auc_lr_flagship.json \
+      >"$OUT/ttauc_lr_flag.out" 2>"$OUT/ttauc_lr_flag.err"
+  tail -2 "$OUT/ttauc_lr_flag.out"
+
+  log "4/6 probe_consolidate"
+  python scripts/probe_consolidate.py \
+      >"$OUT/probe_consolidate.out" 2>"$OUT/probe_consolidate.err"
+  cat "$OUT/probe_consolidate.out"
+
+  log "5/6 bench_models: baseline + D>1 sweeps"
+  python scripts/bench_models.py --batch-log2 17 \
+      >"$OUT/models_base.out" 2>"$OUT/models_base.err"
+  for m in fm mvm wide_deep; do
+    for h in 14 15 16; do
+      python scripts/bench_models.py --model "$m" --batch-log2 17 \
+          --hot-log2 "$h" \
+          >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+      python scripts/bench_models.py --model "$m" --batch-log2 17 \
+          --hot-log2 "$h" --cold-consolidate \
+          >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+    done
+    python scripts/bench_models.py --model "$m" --batch-log2 17 \
+        --hot-log2 14 --hot-dtype bfloat16 \
+        >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
+  done
+  # FFM: no hot geometry fits its 156-wide rows; measure consolidation
+  python scripts/bench_models.py --model ffm --batch-log2 17 \
+      --cold-consolidate \
       >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
-done
-# FFM: no hot geometry fits its 156-wide rows; measure consolidation
-python scripts/bench_models.py --model ffm --batch-log2 17 \
-    --cold-consolidate \
-    >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
-# LR flagship neighbors: resolve round-4's interpolated flagship row
-# with direct measurements (cold 12 — cold 16 IS the step-5 baseline
-# lr row — and bf16 hot)
-python scripts/bench_models.py --model lr --batch-log2 17 \
-    --hot-log2 12 --cold-nnz 12 \
-    >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
-python scripts/bench_models.py --model lr --batch-log2 17 \
-    --hot-log2 12 --hot-dtype bfloat16 \
-    >>"$OUT/models_sweep.out" 2>>"$OUT/models_sweep.err"
-tail -8 "$OUT/models_sweep.out"
+  lr_flagship_neighbors
+  tail -8 "$OUT/models_sweep.out"
 
-log "6/6 time_to_auc t28 sparse inner (north-star table)"
-python scripts/time_to_auc.py --model lr --table-size-log2 28 \
-    --sequential-inner sparse --max-epochs 2 --target-auc 0.99 \
-    --out docs/artifacts/time_to_auc_lr_t28.json \
-    >"$OUT/ttauc_t28.out" 2>"$OUT/ttauc_t28.err"
-tail -2 "$OUT/ttauc_t28.out"
+  log "6/6 t28"
+  ttauc_t28_sparse
+}
 
+part_r5b() {
+  log "1/6 time_to_auc lr, sparse inner (headline north-star attempt)"
+  python scripts/time_to_auc.py --model lr --sequential-inner sparse \
+      --out docs/artifacts/time_to_auc_lr_sparse.json \
+      >"$OUT/ttauc_sparse.out" 2>"$OUT/ttauc_sparse.err"
+  tail -2 "$OUT/ttauc_sparse.out"
+
+  log "1b/6 time_to_auc lr, HYBRID sparse inner + flagship hot geometry"
+  python scripts/time_to_auc.py --model lr --sequential-inner sparse \
+      --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
+      --out docs/artifacts/time_to_auc_lr_sparse_flagship.json \
+      >"$OUT/ttauc_sparse_flag.out" 2>"$OUT/ttauc_sparse_flag.err"
+  tail -2 "$OUT/ttauc_sparse_flag.out"
+
+  log "2/6"; e2e_ckpt_resume
+  log "3/6"; lr_flagship_neighbors
+  log "4/6"; ttauc_t28_sparse
+  log "5/6"; d1_hot_sweeps
+
+  log "6/6 wall-to-AUC for the D>1 families, sparse inner (fm, mvm)"
+  python scripts/time_to_auc.py --model fm --sequential-inner sparse --max-epochs 10 \
+      --out docs/artifacts/time_to_auc_fm_sparse.json \
+      >"$OUT/ttauc_fm.out" 2>"$OUT/ttauc_fm.err"
+  tail -1 "$OUT/ttauc_fm.out"
+  python scripts/time_to_auc.py --model mvm --sequential-inner sparse --max-epochs 10 \
+      --out docs/artifacts/time_to_auc_mvm_sparse.json \
+      >"$OUT/ttauc_mvm.out" 2>"$OUT/ttauc_mvm.err"
+  tail -1 "$OUT/ttauc_mvm.out"
+}
+
+part_r5c() {
+  log "1/4 HEADLINE: time_to_auc lr, hot inner, 2^14 head"
+  python scripts/time_to_auc.py --model lr --sequential-inner hot --max-epochs 9 \
+      --hot-size-log2 14 --hot-nnz 32 --max-nnz 16 \
+      --out docs/artifacts/time_to_auc_lr_hot14.json \
+      >"$OUT/ttauc_hot14.out" 2>"$OUT/ttauc_hot14.err"
+  tail -2 "$OUT/ttauc_hot14.out"
+
+  log "2/4 hot inner, flagship geometry (2^12 head)"
+  python scripts/time_to_auc.py --model lr --sequential-inner hot --max-epochs 9 \
+      --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
+      --out docs/artifacts/time_to_auc_lr_hot_flagship.json \
+      >"$OUT/ttauc_hot_flag.out" 2>"$OUT/ttauc_hot_flag.err"
+  tail -2 "$OUT/ttauc_hot_flag.out"
+
+  log "2b/4 hot inner, half window (B=65536): halves cold staleness"
+  python scripts/time_to_auc.py --model lr --sequential-inner hot --max-epochs 9 \
+      --batch-size 65536 --hot-size-log2 12 --hot-nnz 32 --max-nnz 16 \
+      --out docs/artifacts/time_to_auc_lr_hot_b64k.json \
+      >"$OUT/ttauc_hot_b64k.out" 2>"$OUT/ttauc_hot_b64k.err"
+  tail -2 "$OUT/ttauc_hot_b64k.out"
+
+  log "3/4 north-star table: hot inner at T=2^28 (2 epochs, rate probe)"
+  python scripts/time_to_auc.py --model lr --table-size-log2 28 \
+      --sequential-inner hot --hot-size-log2 14 --hot-nnz 32 --max-nnz 16 \
+      --max-epochs 2 --target-auc 0.99 \
+      --out docs/artifacts/time_to_auc_lr_hot_t28.json \
+      >"$OUT/ttauc_hot_t28.out" 2>"$OUT/ttauc_hot_t28.err"
+  tail -2 "$OUT/ttauc_hot_t28.out"
+
+  log "4/4 D>1 families on the hot inner: fm, mvm wall-to-AUC"
+  python scripts/time_to_auc.py --model fm --sequential-inner hot \
+      --hot-size-log2 14 --hot-nnz 32 --max-nnz 16 --max-epochs 10 \
+      --out docs/artifacts/time_to_auc_fm_hot.json \
+      >"$OUT/ttauc_fm_hot.out" 2>"$OUT/ttauc_fm_hot.err"
+  tail -1 "$OUT/ttauc_fm_hot.out"
+  python scripts/time_to_auc.py --model mvm --sequential-inner hot \
+      --hot-size-log2 14 --hot-nnz 32 --max-nnz 16 --max-epochs 10 \
+      --out docs/artifacts/time_to_auc_mvm_hot.json \
+      >"$OUT/ttauc_mvm_hot.out" 2>"$OUT/ttauc_mvm_hot.err"
+  tail -1 "$OUT/ttauc_mvm_hot.out"
+}
+
+part_r5d() {
+  log "1/3"; e2e_ckpt_resume
+  log "2/3"; lr_flagship_neighbors
+  log "3/3"; d1_hot_sweeps
+
+  log "3b/3 ffm per-table hot (w on MXU, v on DMA)"
+  for h in 12 14 15; do
+    python scripts/bench_models.py --model ffm --batch-log2 17 \
+        --hot-log2 "$h" \
+        >>"$OUT/ffm_hot.out" 2>>"$OUT/ffm_hot.err"
+  done
+  tail -3 "$OUT/ffm_hot.out"
+}
+
+part_store() {
+  # Tiered-store evidence (docs/STORE.md): D>1 at the 2^28 north star
+  # — only trainable through store_mode='tiered' — plus zipf hit-rate
+  # rows for the promotion policy.  Uses the synth zipf generator.
+  log "0/2 synth zipf data"
+  python scripts/gen_synth.py /tmp/xflow_store/zipf 2000000 --num-test 200000 \
+      --zipf-a 1.2 >"$OUT/gen.out" 2>"$OUT/gen.err"
+
+  log "1/2 fm at 2^28, tiered (the PR 8 acceptance geometry at scale)"
+  python -m xflow_tpu.train --model fm \
+      --train /tmp/xflow_store/zipf.train --test /tmp/xflow_store/zipf.test \
+      --epochs 2 --batch-size 8192 --table-size-log2 28 --max-nnz 48 \
+      --store-mode tiered --hot-capacity-log2 18 --num-devices 1 \
+      --metrics-out "$OUT/store_fm28.jsonl" \
+      >"$OUT/store_fm28.out" 2>"$OUT/store_fm28.err"
+  tail -3 "$OUT/store_fm28.out"
+  grep '"kind": "store"' "$OUT/store_fm28.jsonl" | tail -2
+
+  log "2/2 lr tiered vs dense throughput at 2^24 (tiering overhead)"
+  for mode in dense tiered; do
+    extra=""
+    [ "$mode" = tiered ] && extra="--hot-capacity-log2 18"
+    python -m xflow_tpu.train --model lr \
+        --train /tmp/xflow_store/zipf.train --epochs 2 \
+        --batch-size 8192 --table-size-log2 24 --max-nnz 48 \
+        --store-mode "$mode" $extra --num-devices 1 --skip-eval \
+        --metrics-out "$OUT/store_lr_${mode}.jsonl" \
+        >"$OUT/store_lr_${mode}.out" 2>"$OUT/store_lr_${mode}.err"
+    tail -2 "$OUT/store_lr_${mode}.out"
+  done
+}
+
+case "$PART" in
+  r5) part_r5 ;;
+  r5b) part_r5b ;;
+  r5c) part_r5c ;;
+  r5d) part_r5d ;;
+  store) part_store ;;
+  *) echo "unknown part $PART (r5|r5b|r5c|r5d|store)" >&2; exit 2 ;;
+esac
 log "queue complete — results in $OUT and docs/artifacts/"

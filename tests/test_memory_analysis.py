@@ -595,11 +595,19 @@ def test_repo_estimates_cover_every_family_within_budget():
     assert train_key in est
     families = set(doc["geometry"]["families"])
     assert families == {"lr", "fm", "mvm", "ffm", "wide_deep"}
+    # jits that are in-place scatters of donated state have NO sized
+    # transients by design — a zero estimate is the correct answer
+    # there, not a shapeflow bail-out (store/hot.py::_fill_impl writes
+    # PROMOTE_CAP rows with .at[].set into the donated tier)
+    scatter_only = {"store/hot.py::HotTier._fill_impl"}
     for key, fams in est.items():
         assert set(fams) == families
         for family, e in fams.items():
             budget = doc["budgets"][key][family]
-            assert 0 < e["bytes"] <= budget, (key, family, e["bytes"])
+            floor = 0 if key in scatter_only else 1
+            assert floor <= e["bytes"] <= budget, (
+                key, family, e["bytes"],
+            )
     # the window-end [T, D] path is among the sized sites
     sites = est[train_key]["fm"]["sites"]
     assert any(

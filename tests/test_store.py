@@ -1,0 +1,468 @@
+"""Hierarchical hot/cold parameter store (store/; docs/STORE.md):
+config validation, cold-store semantics, tier-erased checkpoint
+round-trip, zipf promotion convergence, the 2^28 acceptance geometry,
+and the tier-1 smoke gate wiring."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from xflow_tpu.config import Config
+from xflow_tpu.trainer import Trainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cfg_for(ds, ndev=1, **kw):
+    base = dict(
+        train_path=ds.train_prefix,
+        test_path=ds.test_prefix,
+        model="fm",
+        epochs=1,
+        batch_size=64,
+        table_size_log2=16,
+        max_nnz=24,
+        num_devices=ndev,
+        store_mode="tiered",
+        hot_capacity_log2=10,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+# -- config validation (satellite: actionable rejects) ---------------------
+
+
+def test_hot_capacity_exceeding_table_rejected():
+    with pytest.raises(ValueError, match="hot_capacity_log2"):
+        Config(
+            store_mode="tiered", table_size_log2=14, hot_capacity_log2=15
+        )
+
+
+def test_tiered_with_sequential_rejected():
+    with pytest.raises(ValueError, match="sequential"):
+        Config(
+            store_mode="tiered",
+            hot_capacity_log2=10,
+            update_mode="sequential",
+        )
+
+
+def test_tiered_with_hot_table_rejected():
+    with pytest.raises(ValueError, match="subsumes"):
+        Config(
+            store_mode="tiered", hot_capacity_log2=10, hot_size_log2=8
+        )
+
+
+def test_tiered_with_microbatch_rejected():
+    with pytest.raises(ValueError, match="microbatch"):
+        Config(store_mode="tiered", hot_capacity_log2=10, microbatch=4)
+
+
+def test_unknown_store_mode_rejected():
+    with pytest.raises(ValueError, match="store_mode"):
+        Config(store_mode="paged")
+
+
+def test_cli_store_flags():
+    from xflow_tpu.train import build_parser, config_from_args
+
+    args = build_parser().parse_args([
+        "--train", "x", "--store-mode", "tiered",
+        "--hot-capacity-log2", "11", "--store-promote-every", "4",
+        "--table-size-log2", "16",
+    ])
+    cfg = config_from_args(args)
+    assert cfg.store_mode == "tiered"
+    assert cfg.hot_capacity_log2 == 11
+    assert cfg.store_promote_every == 4
+
+
+# -- cold store unit -------------------------------------------------------
+
+
+def test_cold_store_lazy_init_deterministic_and_t_independent():
+    from xflow_tpu.store.cold import row_init_values
+
+    rows = np.asarray([0, 7, 123456789, (1 << 28) - 1], np.int64)
+    a = row_init_values(3, "v", "param", rows, 10, "normal", 1e-2)
+    b = row_init_values(3, "v", "param", rows, 10, "normal", 1e-2)
+    assert np.array_equal(a, b)
+    assert a.shape == (4, 10) and a.dtype == np.float32
+    # distinct rows/tables draw distinct values; zeros kind is zeros
+    c = row_init_values(3, "w", "param", rows, 10, "normal", 1e-2)
+    assert not np.array_equal(a, c)
+    assert not np.array_equal(a[0], a[1])
+    z = row_init_values(3, "v", "n", rows, 10, "zeros", 0.0)
+    assert not z.any()
+    # scale is honored at the reference's 1e-2 magnitude
+    assert 0.001 < np.abs(a).mean() < 0.02
+
+
+def test_cold_store_fetch_write_take():
+    from xflow_tpu.store.cold import ColdStore, ColdTableSpec
+
+    store = ColdStore(
+        {
+            "w": ColdTableSpec(1, {"param": ("zeros", 0.0)}),
+            "v": ColdTableSpec(4, {"param": ("normal", 1e-2)}),
+        },
+        seed=0,
+    )
+    keys = np.asarray([5, 9, 2], np.int64)
+    lazy = store.fetch(keys)
+    assert len(store) == 0  # fetch never inserts
+    rows = {
+        "w": {"param": np.ones((3, 1), np.float32)},
+        "v": {"param": np.full((3, 4), 2.0, np.float32)},
+    }
+    store.write(keys, rows)
+    assert len(store) == 3
+    got = store.fetch(np.asarray([9, 2, 77], np.int64))
+    assert np.array_equal(got["w"]["param"][:2], np.ones((2, 1)))
+    # absent key 77 falls back to lazy init (v: deterministic normal)
+    assert np.array_equal(
+        got["v"]["param"][2], store.lazy_rows("v", "param", np.asarray([77]))[0]
+    )
+    taken = store.take(np.asarray([9], np.int64))
+    assert float(taken["w"]["param"][0, 0]) == 1.0
+    assert len(store) == 2
+    # re-fetch of a taken key is lazy again
+    refetch = store.fetch(np.asarray([9], np.int64))
+    assert float(refetch["w"]["param"][0, 0]) == 0.0
+    # the other rows survived the swap-with-last compaction
+    left = store.fetch(keys)
+    assert np.array_equal(
+        left["v"]["param"][[0, 2]], np.full((2, 4), 2.0, np.float32)
+    )
+    assert np.array_equal(lazy["w"]["param"], np.zeros((3, 1)))
+
+
+def test_table_spec_init_declarations_match_eager_init():
+    """TableSpec carries the init distribution twice — the eager
+    ``init`` lambda (dense mode) and the declarative
+    init_kind/init_scale (the store's lazy per-row init).  Pin their
+    agreement so an edit to one cannot silently diverge dense-mode and
+    tiered-mode starting tables: zeros-kind tables must init to zeros,
+    normal-kind tables to N(0,1)*init_scale (std within 20%)."""
+    from xflow_tpu.models import make_model
+
+    for name in ("lr", "fm", "mvm", "ffm", "wide_deep"):
+        model = make_model(Config(model=name))
+        for spec in model.tables():
+            arr = np.asarray(
+                spec.init(jax.random.PRNGKey(0), (4096, spec.dim))
+            )
+            if spec.init_kind == "zeros":
+                assert not arr.any(), (name, spec.name)
+                assert spec.init_scale == 0.0
+            else:
+                assert spec.init_kind == "normal", (name, spec.name)
+                std = float(arr.std())
+                assert (
+                    0.8 * spec.init_scale < std < 1.2 * spec.init_scale
+                ), (name, spec.name, std, spec.init_scale)
+
+
+# -- end-to-end tiered training --------------------------------------------
+
+
+def test_tiered_trains_and_emits_store_rows(toy_dataset, tmp_path):
+    metrics = tmp_path / "m.jsonl"
+    cfg = cfg_for(toy_dataset, epochs=2, metrics_out=str(metrics))
+    with Trainer(cfg) as t:
+        hist = t.train()
+        assert len(hist) == 2
+        assert hist[1]["train_logloss"] < hist[0]["train_logloss"]
+        res = t.evaluate()
+        assert res["auc"] > 0.6
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+
+    rows = load_jsonl(str(metrics))
+    assert validate_rows(rows) == []
+    store_rows = [r for r in rows if r["kind"] == "store"]
+    assert len(store_rows) == 2
+    assert store_rows[0]["promotions"] > 0
+    # warm epoch: the toy working set fits 2^10 slots entirely
+    assert store_rows[1]["hot_hit_rate"] > 0.9
+    assert 0.0 < store_rows[1]["hot_occupancy"] <= 1.0
+
+
+def test_tiered_checkpoint_roundtrip_bitwise(toy_dataset, tmp_path):
+    """Mid-run save with rows split across BOTH tiers -> restore ->
+    bitwise-equal logical table including FTRL slots (the tier-erased
+    fold contract, store/tiered.py)."""
+    # capacity 2^5 = 32 slots << touched keys: rows MUST split
+    cfg = cfg_for(
+        toy_dataset,
+        hot_capacity_log2=5,
+        checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every_steps=3,
+    )
+    t1 = Trainer(cfg)
+    t1.train()
+    st1 = t1.step.store
+    assert st1.hot.occupancy > 0, "nothing promoted"
+    assert len(st1.cold) > 0, "nothing stayed cold — tiers not split"
+    hot_keys = st1.hot.key_of[st1.hot.key_of >= 0]
+    cold_keys = st1.cold._keys[: len(st1.cold)]
+    probe = np.unique(np.concatenate([
+        hot_keys[:40], cold_keys[:40],
+        np.asarray([1, 2, 3, 60000], np.int64),  # incl. untouched
+    ]))
+    before = {
+        tn: st1.logical_rows(t1.state, tn, probe) for tn in ("w", "v")
+    }
+    assert set(before["w"]) == {"param", "n", "z"}  # FTRL slots ride too
+    t1.save(0, 0)
+
+    t2 = Trainer(cfg)
+    assert t2.restore() is not None
+    st2 = t2.step.store
+    # restore is all-cold; the logical table must not care
+    assert st2.hot.occupancy == 0
+    after = {
+        tn: st2.logical_rows(t2.state, tn, probe) for tn in ("w", "v")
+    }
+    for tn in before:
+        for an in before[tn]:
+            assert np.array_equal(before[tn][an], after[tn][an]), (tn, an)
+    # training continues from the restored table
+    t2.train()
+    t1.close()
+    t2.close()
+
+
+def test_same_instance_restore_resets_promoter(toy_dataset, tmp_path):
+    """restore() on a LIVE trainer (rollback) must reset the promotion
+    worker along with the maps it mirrors — a stale worker hot_view
+    would filter the hottest keys out of every future promotion plan."""
+    cfg = cfg_for(toy_dataset, checkpoint_dir=str(tmp_path / "ck"))
+    with Trainer(cfg) as t:
+        t.train()
+        t.save(0, 0)
+        store = t.step.store
+        assert store.promoter is not None
+        assert store.hot.occupancy > 0
+        assert t.restore() is not None
+        t.epoch = 0  # roll back: re-train the epoch from the ckpt
+        # worker recreated fresh (lazily, on the next plan)
+        assert store.promoter is None
+        assert store.hot.occupancy == 0
+        hist = t.train()  # rolls forward again: promotion must re-warm
+        assert np.isfinite(hist[-1]["train_logloss"])
+        assert store.hot.occupancy > 0
+
+
+def test_dense_restore_of_tiered_checkpoint_refused(toy_dataset, tmp_path):
+    ck = str(tmp_path / "ck")
+    cfg = cfg_for(toy_dataset, checkpoint_dir=ck)
+    with Trainer(cfg) as t:
+        t.train()
+        t.save(0, 0)
+    dense_cfg = cfg.replace(store_mode="dense", hot_capacity_log2=18)
+    logs = []
+    t2 = Trainer(dense_cfg, log=logs.append)
+    assert t2.restore() is None  # refused, starts fresh — with a reason
+    assert any("tiered" in m for m in logs)
+    t2.close()
+
+
+def test_tiered_restore_of_dense_checkpoint_refused(toy_dataset, tmp_path):
+    ck = str(tmp_path / "ck")
+    dense_cfg = cfg_for(
+        toy_dataset, store_mode="dense", hot_capacity_log2=18,
+        checkpoint_dir=ck,
+    )
+    with Trainer(dense_cfg) as t:
+        t.train()
+        t.save(0, 0)
+    cfg = cfg_for(toy_dataset, checkpoint_dir=ck)
+    logs = []
+    t2 = Trainer(cfg, log=logs.append)
+    assert t2.restore() is None
+    assert any("store" in m for m in logs)
+    t2.close()
+
+
+def test_tiered_multi_device_mesh(toy_dataset):
+    """The hot tier row-shards over the mesh (parallel/mesh.py): a
+    4-device run trains and the tier geometry divides."""
+    cfg = cfg_for(toy_dataset, ndev=4, batch_size=64)
+    with Trainer(cfg) as t:
+        hist = t.train()
+        assert np.isfinite(hist[0]["train_logloss"])
+
+
+def test_fm_trains_tiered_at_2pow28(toy_dataset):
+    """The acceptance geometry: fm (D>1) at table_size_log2=28 under
+    store_mode='tiered' on the CPU mesh — impossible as a dense table
+    (one [T, D] f32 buffer alone is 10 GiB); the tiered run bounds
+    device state by hot capacity and host state by touched rows."""
+    cfg = cfg_for(
+        toy_dataset, table_size_log2=28, hot_capacity_log2=12, epochs=1
+    )
+    with Trainer(cfg) as t:
+        hist = t.train()
+        assert np.isfinite(hist[0]["train_logloss"])
+        store = t.step.store
+        # host cold rows are O(touched), nowhere near 2^28
+        assert 0 < len(store.cold) + store.hot.occupancy < 1 << 20
+        res = t.evaluate()
+        assert 0.0 < res["logloss"] < 1.0
+
+
+def test_zipf_promotion_reaches_hot_hit_rate(tmp_path):
+    """Satellite: zipf traffic (the synth generator's distribution,
+    scripts/gen_synth.py) at hot capacity 2^12 — after the warmup
+    epoch the hot tier must serve > 0.9 of feature occurrences."""
+    prefix = str(tmp_path / "zipf")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "gen_synth.py"),
+            prefix, "8192", "--zipf-a", "2.0", "--seed", "11",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    metrics = tmp_path / "m.jsonl"
+    cfg = Config(
+        train_path=prefix + ".train",
+        model="lr",
+        epochs=2,
+        batch_size=256,
+        table_size_log2=16,
+        max_nnz=48,
+        num_devices=1,
+        store_mode="tiered",
+        hot_capacity_log2=12,
+        metrics_out=str(metrics),
+    )
+    with Trainer(cfg) as t:
+        t.train()
+    from xflow_tpu.obs.schema import load_jsonl
+
+    store_rows = [
+        r for r in load_jsonl(str(metrics)) if r["kind"] == "store"
+    ]
+    assert len(store_rows) == 2
+    warm = store_rows[1]
+    assert warm["hot_hit_rate"] > 0.9, store_rows
+    assert warm["hot_occupancy"] > 0.0
+
+
+def test_predict_batch_refused_tiered(toy_dataset):
+    from xflow_tpu.api import XFlow
+
+    xf = XFlow(
+        train_path=toy_dataset.train_prefix,
+        model="lr",
+        epochs=1,
+        batch_size=64,
+        table_size_log2=16,
+        max_nnz=24,
+        num_devices=1,
+        store_mode="tiered",
+        hot_capacity_log2=10,
+    )
+    from xflow_tpu.io.batch import Batch
+
+    b = Batch(
+        keys=np.zeros((1, 4), np.int32),
+        slots=np.zeros((1, 4), np.int32),
+        vals=np.ones((1, 4), np.float32),
+        mask=np.ones((1, 4), np.float32),
+        labels=np.zeros(1, np.float32),
+        weights=np.ones(1, np.float32),
+    )
+    with pytest.raises(ValueError, match="export_artifact"):
+        xf.predict_batch(b)
+    xf.trainer.close()
+
+
+def test_promotion_worker_closes_without_leak():
+    from xflow_tpu.store.promote import PromotionWorker
+
+    before = {t.ident for t in threading.enumerate()}
+    w = PromotionWorker(64)
+    w.note(
+        np.asarray([3, 5], np.int64),
+        np.asarray([4, 1], np.int64),
+        np.asarray([True, True]),
+    )
+    # the worker proposes promotion of the touched misses
+    plan = None
+    for _ in range(200):
+        plan = w.poll_plan()
+        if plan is not None:
+            break
+        import time
+
+        time.sleep(0.01)
+    assert plan is not None and set(plan["promote"]) == {3, 5}
+    assert w.close()
+    leftover = {
+        t.ident for t in threading.enumerate()
+    } - before
+    assert not leftover
+
+
+def test_store_thrash_doctor_diagnosis():
+    """obs doctor gains the store-thrash cause: low warm hit rate +
+    churn -> warn; the first (warmup) row is exempt."""
+    from xflow_tpu.obs.doctor import diagnose
+
+    def store_row(epoch, rate, promos, demos):
+        return {
+            "t": float(epoch), "kind": "store", "epoch": epoch,
+            "hot_hit_rate": rate, "promotions": promos,
+            "demotions": demos, "cold_fetch_seconds": 0.1,
+            "hot_occupancy": 1.0,
+        }
+
+    header = {
+        "t": 0.0, "kind": "run_start", "run_id": "x",
+        "config_digest": "d", "rank": 0, "num_hosts": 1,
+        "time_unix": 0.0,
+    }
+    sick = [header, store_row(0, 0.1, 500, 0),
+            store_row(1, 0.3, 400, 400)]
+    codes = {d.code for d in diagnose(sick)}
+    assert "store_thrash" in codes
+    # a SATURATED tier with zero churn (swap hysteresis) serving a
+    # too-large working set is the same condition — occupancy fires it
+    saturated = [header, store_row(0, 0.1, 500, 0),
+                 store_row(1, 0.3, 0, 0)]
+    codes = {d.code for d in diagnose(saturated)}
+    assert "store_thrash" in codes
+    # warmup-only miss storm is NOT thrash
+    healthy = [header, store_row(0, 0.1, 500, 0),
+               store_row(1, 0.97, 3, 3)]
+    codes = {d.code for d in diagnose(healthy)}
+    assert "store_thrash" not in codes
+
+
+def test_check_store_smoke_script():
+    """The CI lint (scripts/check_store_smoke.py) passes — run as a
+    subprocess exactly as CI would (tier-1 wiring, like
+    check_serve_smoke.py)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_store_smoke.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

@@ -97,10 +97,19 @@ def _is_hot_path(rel: str) -> bool:
 _T, _D, _B, _K, _Kh, _H = (
     dsym("T"), dsym("D"), dsym("B"), dsym("K"), dsym("Kh"), dsym("H")
 )
+# tiered-store dims (store/hot.py): Hc = hot-tier rows
+# (cfg.hot_capacity), M = per-batch miss-block capacity (granule-
+# bucketed, <= B*K), P = the fixed promotion/demotion transfer width
+# (store/hot.py::PROMOTE_CAP)
+_Hc, _M, _P = dsym("Hc"), dsym("M"), dsym("P")
 
 
 def _table() -> MapV:
     return MapV({}, lambda: ArrV((_T, _D), "float32"))
+
+
+def _hot_table() -> MapV:
+    return MapV({}, lambda: ArrV((_Hc, _D), "float32"))
 
 
 def _batch() -> MapV:
@@ -123,6 +132,39 @@ def _batch() -> MapV:
 
 
 def seed_param(name: str) -> Any:
+    f32 = "float32"
+    if name == "tstate":
+        # tiered device state (store/hot.py): tables are [Hc, D]
+        return MapV(
+            {
+                "tables": MapV({}, _hot_table),
+                "dense": UNK,
+                "step": ArrV((), "int32"),
+            },
+            None,
+        )
+    if name == "tbatch":
+        # tiered wire (store/tiered.py::plan_batch): refs replace keys;
+        # miss blocks are [M, D] per table array
+        return MapV(
+            {
+                "refs": ArrV((_B, _K), "int32"),
+                "slots": ArrV((_B, _K), "int32"),
+                "vals": ArrV((_B, _K), f32),
+                "mask": ArrV((_B, _K), f32),
+                "labels": ArrV((_B,), f32),
+                "weights": ArrV((_B,), f32),
+                "miss": MapV(
+                    {}, lambda: MapV({}, lambda: ArrV((_M, _D), f32))
+                ),
+            },
+            None,
+        )
+    if name == "slots":
+        # promotion/demotion slot plane (store/hot.py fill/read)
+        return ArrV((_P,), "int32")
+    if name == "fill_rows":
+        return MapV({}, lambda: MapV({}, lambda: ArrV((_P, _D), f32)))
     if name == "state":
         return MapV(
             {

@@ -221,11 +221,14 @@ class AtIdxV:
     idx: Any
 
 
-# Config attribute -> symbol.  table_size/hot_size are the @property
-# spellings of the *_log2 knobs (config.py).
+# Config attribute -> symbol.  table_size/hot_size/hot_capacity are
+# the @property spellings of the *_log2 knobs (config.py).  Hc is the
+# tiered store's hot-tier row count (store/hot.py) — the dim the
+# store's transients are PROVEN to scale with instead of T (XF014).
 CONFIG_SYMS = {
     "table_size": "T",
     "hot_size": "H",
+    "hot_capacity": "Hc",
     "max_nnz": "K",
     "hot_nnz": "Kh",
     "microbatch": "S",

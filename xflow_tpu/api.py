@@ -36,6 +36,14 @@ class XFlow:
         always current), so batch sizes snap onto the engine's shape
         buckets: scoring a previously unseen batch size pads instead of
         triggering a fresh XLA compile (serve/engine.py)."""
+        if self.config.store_mode == "tiered":
+            raise ValueError(
+                "predict_batch over the LIVE trainer state needs the "
+                "whole table in device memory, which store_mode="
+                "'tiered' deliberately avoids — export_artifact() and "
+                "score through PredictEngine.load (the export folds "
+                "both tiers into one logical table; docs/STORE.md)"
+            )
         if self._engine is None:
             from xflow_tpu.serve.engine import PredictEngine
 

@@ -344,6 +344,34 @@ class Config:
     # way; scatter differs only in summation order.
     hot_impl: str = "auto"  # {"auto", "mxu", "seg"}
 
+    # -- hierarchical parameter store (store/; docs/STORE.md) --
+    # "dense": the whole [T, D] table lives in device HBM (every mode
+    #   above) — the small-table form.
+    # "tiered": HBM holds only a bounded HOT tier of
+    #   2^hot_capacity_log2 rows (mesh-row-sharded, store/hot.py); the
+    #   2^table_size_log2-row cold tail lives in HOST memory
+    #   (store/cold.py, touched rows only — untouched rows materialize
+    #   lazily from the per-row init, TableSpec.init_kind) and an async
+    #   worker (store/promote.py) promotes/demotes rows by touch
+    #   frequency.  Per-batch misses ride the wire as a packed row
+    #   block and write back after the step, so every jitted transient
+    #   scales with hot capacity, never T (analysis rules XF010/XF014)
+    #   — the form that makes FM/MVM/FFM trainable at the north-star
+    #   2^28 geometry, mirroring hierarchical parameter servers for
+    #   massive ads models (arXiv:2003.05622).  Requires
+    #   update_mode='dense' or 'sparse' (the optimizer applies once
+    #   per dispatch either way), microbatch=1, hot_size_log2=0 (the
+    #   tier subsumes the MXU frequency head), and a single process.
+    store_mode: str = "dense"  # {"dense", "tiered"}
+    # log2 rows of the HBM-resident hot tier under store_mode='tiered'.
+    # Budget math at 2^28 lives in docs/STORE.md; must not exceed
+    # table_size_log2 (a tier bigger than the table is a config bug).
+    hot_capacity_log2: int = 18
+    # Apply pending promotion/demotion plans every N train steps (the
+    # async worker only PROPOSES; application is a between-steps device
+    # fill/read so in-flight batches never see a moving key->slot map).
+    store_promote_every: int = 1
+
     # Device staging ring depth: how many batches ahead the host->device
     # transfer (put_batch — compaction + h2d) runs on worker threads,
     # overlapping link round-trips and compaction with device compute
@@ -416,6 +444,49 @@ class Config:
             raise ValueError(f"unknown wire_dedup {self.wire_dedup!r}")
         if self.hot_impl not in ("auto", "mxu", "seg"):
             raise ValueError(f"unknown hot_impl {self.hot_impl!r}")
+        if self.store_mode not in ("dense", "tiered"):
+            raise ValueError(f"unknown store_mode {self.store_mode!r}")
+        if self.store_mode == "tiered":
+            if self.hot_capacity_log2 > self.table_size_log2:
+                raise ValueError(
+                    f"hot_capacity_log2 {self.hot_capacity_log2} exceeds "
+                    f"table_size_log2 {self.table_size_log2}: the hot "
+                    "tier cannot hold more rows than the logical table "
+                    "— lower --hot-capacity-log2 (or use "
+                    "store_mode='dense', which fits the whole table in "
+                    "HBM at this size)"
+                )
+            if self.hot_capacity_log2 < 1:
+                raise ValueError(
+                    "hot_capacity_log2 must be >= 1 under "
+                    "store_mode='tiered'"
+                )
+            if self.update_mode == "sequential":
+                raise ValueError(
+                    "store_mode='tiered' does not compose with "
+                    "update_mode='sequential': the sequential scan "
+                    "carries full tables through the microbatch slices, "
+                    "which is exactly the [T, D] residency the tiered "
+                    "store removes — use update_mode='dense' (optimizer "
+                    "over the hot+miss tier) or 'sparse' (touched rows "
+                    "only), with microbatch for memory if needed"
+                )
+            if self.microbatch > 1:
+                raise ValueError(
+                    "store_mode='tiered' requires microbatch=1: the "
+                    "tiered step already bounds every transient by hot "
+                    "capacity, so gradient-accumulation slicing has "
+                    "nothing left to shrink"
+                )
+            if self.hot_size_log2:
+                raise ValueError(
+                    "store_mode='tiered' subsumes the MXU frequency-hot "
+                    "head (the hot tier IS the frequency head, kept "
+                    "fresh by the promotion worker) — set "
+                    "hot_size_log2=0"
+                )
+        if self.store_promote_every < 1:
+            raise ValueError("store_promote_every must be >= 1")
         if self.transfer_ahead < 1:
             raise ValueError("transfer_ahead must be >= 1")
         if self.obs_trace_capacity < 1:
@@ -439,6 +510,12 @@ class Config:
     @property
     def hot_size(self) -> int:
         return (1 << self.hot_size_log2) if self.hot_size_log2 else 0
+
+    @property
+    def hot_capacity(self) -> int:
+        """Hot-tier rows under store_mode='tiered' (shapeflow symbol
+        Hc — analysis/shapeflow.py CONFIG_SYMS)."""
+        return 1 << self.hot_capacity_log2
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)

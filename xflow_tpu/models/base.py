@@ -46,6 +46,18 @@ class TableSpec:
     # takes the MXU win on its scalar w and leaves v on DMA, halving
     # its per-occurrence descriptor count.
     hot: bool = True
+    # Declarative row-init distribution, the LAZY counterpart of
+    # ``init``: the tiered parameter store (store/cold.py) materializes
+    # a row only when it is first touched, so the initial value of row
+    # r must be computable per-row, deterministically, and independent
+    # of the table size — a [T, D] init draw is exactly the full-table
+    # materialization the store exists to avoid at T=2^28.
+    # "zeros" covers w tables; "normal" is N(0,1)*init_scale per entry
+    # (the reference's lazy server-side v init, ftrl.h:113-120 — which
+    # was itself per-row-on-first-touch, so the store reproduces the
+    # REFERENCE semantics more literally than the eager ``init`` does).
+    init_kind: str = "zeros"  # {"zeros", "normal"}
+    init_scale: float = 0.0
 
 
 class Model(Protocol):

@@ -60,6 +60,8 @@ class FFMModel(AutodiffModel):
                 # replaces, so only w rides the MXU hot path
                 # (TableSpec.hot rationale)
                 hot=False,
+                init_kind="normal",
+                init_scale=self.v_init_scale,
             ),
         ]
 

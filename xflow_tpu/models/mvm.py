@@ -64,6 +64,8 @@ class MVMModel:
                 lambda rng, shape: (
                     jax.random.normal(rng, shape, jnp.float32) * self.v_init_scale
                 ),
+                init_kind="normal",
+                init_scale=self.v_init_scale,
             )
         ]
 

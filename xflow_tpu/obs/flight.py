@@ -106,6 +106,13 @@ class FlightRecorder:
         executed) one batch."""
         self._note("serve", detail, channel="serve")
 
+    def note_store(self, detail: str = "note") -> None:
+        """Tiered-store heartbeat: the promotion worker scored a batch
+        of touch counts (store/promote.py).  Not watchdog-classified —
+        placement is advisory — but the channel age in a flight dump
+        separates 'promoter wedged' from 'promoter idle'."""
+        self._note("store", detail, channel="store")
+
     def note_batch(self, shape: dict[str, Any]) -> None:
         """Record the most recent batch geometry (rows/nnz/bucket) —
         the 'what data was in flight' forensic."""

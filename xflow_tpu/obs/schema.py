@@ -106,6 +106,23 @@ SCHEMA: dict[str, dict[str, Any]] = {
         "wire_bytes_per_example": (int, float),
         "compaction_ratio": (int, float),
     },
+    # one per training epoch under store_mode='tiered': hierarchical
+    # parameter-store accounting (store/tiered.py; docs/STORE.md).
+    # hot_hit_rate is occurrence-weighted (feature occurrences the HBM
+    # hot tier served / all real occurrences); cold_fetch_seconds is
+    # host time spent gathering miss rows; hot_occupancy is the
+    # fraction of hot-tier slots assigned at epoch end.  `obs doctor`
+    # reads these for the store-thrash diagnosis.
+    "store": {
+        "t": (int, float),
+        "kind": str,
+        "epoch": int,
+        "hot_hit_rate": (int, float),
+        "promotions": int,
+        "demotions": int,
+        "cold_fetch_seconds": (int, float),
+        "hot_occupancy": (int, float),
+    },
     # -- serving (serve/; docs/SERVING.md) ---------------------------------
     # one per PredictEngine artifact load: bucket geometry + warmup cost
     "serve_load": {

@@ -46,6 +46,57 @@ from xflow_tpu.utils.metrics import logloss, logloss_sum, sigmoid_ref
 State = dict[str, Any]
 
 
+def grads_from_rows(model, rows: dict, dense: dict, mbatch: BatchArrays,
+                    num_real: jax.Array):
+    """pctr + per-occurrence gradients, rows already gathered: the ONE
+    forward/backward shared by TrainStep (all update modes) and the
+    tiered store's hot+miss step (store/hot.py) so the two cannot
+    drift.  ``mbatch`` is the model view (hot/cold sections already
+    concatenated where applicable).  Returns (pctr, occ_grads,
+    grad_dense_or_None); occ_grads are residual-scaled and divided by
+    ``num_real``, the reference's mean-gradient semantics
+    (lr_worker.cc:116-118)."""
+    if getattr(model, "autodiff", False):
+        # Autodiff path (FFM, wide&deep — no reference gradient
+        # quirks): stable BCE-with-logits; d/dlogit = sigmoid - y,
+        # the same residual semantics as the explicit path.
+        def loss_fn(rows_, dense_):
+            logit_ = model.logit(rows_, mbatch, dense_)
+            nll = jax.nn.softplus(logit_) - mbatch["labels"] * logit_
+            return (
+                jnp.sum(nll * mbatch["weights"]) / num_real,
+                logit_,
+            )
+
+        (_, logit), (grad_rows, grad_dense) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(rows, dense)
+        return sigmoid_ref(logit), grad_rows, (grad_dense or None)
+    logit = model.logit(rows, mbatch)
+    pctr = sigmoid_ref(logit)
+    # Residual "loss" exactly as the reference names it
+    # (lr_worker.cc:121-143): sigma(wx) - y, zeroed for pad
+    # examples, pre-divided by batch size for the mean-gradient
+    # semantics.
+    residual = (pctr - mbatch["labels"]) * mbatch["weights"] / num_real
+    grad_logit = model.grad_logit(rows, mbatch)
+    occ_grads = {
+        name: g * residual[:, None, None]
+        for name, g in grad_logit.items()
+    }
+    return pctr, occ_grads, None
+
+
+def apply_dense_sgd(dense: dict, grad_dense, lr: float) -> dict:
+    """Dense (MLP) params take plain SGD regardless of the table
+    optimizer (models/wide_deep.py rationale) — the ONE copy of that
+    rule, shared by TrainStep (per-dispatch and per-slice application)
+    and the tiered store's step (store/hot.py)."""
+    if not dense or grad_dense is None:
+        return dense
+    return jax.tree.map(lambda p, g: p - lr * g, dense, grad_dense)
+
+
 def init_state(model: Model, optimizer: Optimizer, cfg: Config, mesh) -> State:
     """Create sharded zero/random-initialized tables (plus replicated
     dense params for models that have them).
@@ -325,29 +376,58 @@ class TrainStep:
             and cfg.wire_dedup != "off"
             and dict_ok
         )
+        # Hierarchical parameter store (Config.store_mode; store/):
+        # under 'tiered' the table state is the store's hot tier + host
+        # cold rows, the wire is the store's refs/miss format (the
+        # compact/dict wires encode raw table keys, which the tiered
+        # step never sees), and train/predict dispatch through the
+        # store's hot+miss jits (store/hot.py).
+        self.store = None
+        if cfg.store_mode == "tiered":
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "store_mode='tiered' is single-process for now: the "
+                    "cold row store is host-local (multi-host would "
+                    "need a sharded cold tier — docs/STORE.md)"
+                )
+            from xflow_tpu.store.tiered import TieredStore
+
+            self.store = TieredStore(model, optimizer, cfg, mesh)
+            self.compact_wire = False
+            self.dict_wire = False
         # Observability hook (obs/__init__.py): the trainer swaps in a
         # live Obs; the default NULL_OBS makes every span a shared no-op
         # object, so direct users (bench.py run()) pay nothing.
         self.obs = NULL_OBS
         self.train = jax.jit(self._train_impl, donate_argnums=0)
         self.predict = jax.jit(self._predict_impl)
+        if self.store is not None:
+            # tiered predict consumes the refs/miss wire, not key
+            # planes — rebind AFTER the plain jit binding above so the
+            # analysis pass still discovers _predict_impl as an entry
+            self.predict = self.store.hot.predict
 
     # -- helpers -----------------------------------------------------------
 
-    def put_batch(self, batch) -> BatchArrays:
+    def put_batch(self, batch, predict: bool = False) -> BatchArrays:
         """Host->device transfer, booked as the 'h2d' phase; accepts a
         Batch or a pre-compacted CompactBatch (packed-cache v2
         records).  Under trainer._transfer_ahead this runs on a worker
         thread and the seconds land in the epoch record's overlapped
         dict; called inline (multi-host, eval) they are
-        main-thread-exclusive."""
+        main-thread-exclusive.  ``predict`` (eval/serving callers)
+        matters only to the tiered store: predict misses ship the
+        param plane alone — the optimizer slots never score, and the
+        staging ring is off there, so the saved fetch+transfer is
+        serial time (store/tiered.py).  The dense wire ignores it."""
         with self.obs.phase("h2d"):
-            return self._put_batch_impl(batch)
+            return self._put_batch_impl(batch, predict=predict)
 
     @property
     def wire_format(self) -> str:
         return (
-            "dict" if self.dict_wire
+            "tiered" if self.store is not None
+            else "dict" if self.dict_wire
             else "compact" if self.compact_wire
             else "full"
         )
@@ -412,7 +492,51 @@ class TrainStep:
             })
         return wire, None
 
-    def _put_batch_impl(self, batch) -> BatchArrays:
+    def _put_batch_tiered(self, batch, predict: bool = False) -> BatchArrays:
+        """Tiered-store staging (Config.store_mode): flush the previous
+        step's miss write-back (read-your-writes — the next plan's
+        cold-fetch must see it), resolve this batch's keys through the
+        hot map, fetch miss rows from the host cold store, and ship
+        refs + miss blocks.  The plan stays armed on the store until
+        dispatch_train pairs it with the step's miss output."""
+        from xflow_tpu.io.compact import CompactBatch
+
+        if isinstance(batch, CompactBatch):
+            batch = batch.expand()
+        store = self.store
+        store.complete_pending()
+        wire, plan = store.plan_batch(
+            batch, obs=self.obs, param_only=predict
+        )
+        self._book_wire(
+            sum(int(v.nbytes) for v in wire.values())
+            + plan.miss_nbytes,
+            batch.num_real(),
+        )
+        from xflow_tpu.parallel.mesh import replicated
+
+        # one direct host->device transfer per plane (a jnp.asarray
+        # hop first would commit to the default device and pay a
+        # second device-to-device reshard — on a path where the
+        # staging ring is pinned off, that cost is fully serial)
+        arrays = {
+            k: jax.device_put(v, self._bsharding)
+            for k, v in wire.items()
+        }
+        rep = replicated(self.mesh)
+        arrays["miss"] = {
+            tname: {
+                aname: jax.device_put(a, rep)
+                for aname, a in arrs.items()
+            }
+            for tname, arrs in plan.miss_rows.items()
+        }
+        store.stage(arrays, plan)
+        return arrays
+
+    def _put_batch_impl(self, batch, predict: bool = False) -> BatchArrays:
+        if self.store is not None:
+            return self._put_batch_tiered(batch, predict=predict)
         wire, cb = self.host_wire_np(
             # one-way idempotent latch: racing transfer-ahead workers
             # can at worst BOTH run the first-batch validation — extra
@@ -450,7 +574,21 @@ class TrainStep:
         epoch-end metrics fetch) — the dispatch/block split is what
         tells an input-bound run from a compute-bound one."""
         with self.obs.phase("dispatch"):
+            if self.store is not None:
+                return self._dispatch_tiered(state, arrays)
             return self.train(state, arrays)
+
+    def _dispatch_tiered(
+        self, state: State, arrays: BatchArrays
+    ) -> tuple[State, dict[str, jax.Array]]:
+        """Tiered dispatch: pair THESE arrays' staged plan (identity-
+        keyed — a foreign arrays dict raises) with the hot+miss jit and
+        defer the miss write-back (completed before the next plan —
+        store/tiered.py ordering)."""
+        plan = self.store.take_staged(arrays)
+        new_state, miss_out, metrics = self.store.hot.train(state, arrays)
+        self.store.defer_complete(plan, miss_out)
+        return new_state, metrics
 
     def _expand_dict_wire(self, w: BatchArrays) -> BatchArrays:
         """Inverse of CompactBatch.wire (io/compact.py), inside the
@@ -737,37 +875,11 @@ class TrainStep:
         """_forward_grads with the row gather already done — the hot
         sequential inner supplies rows from the carried hot head plus
         a window-start cold pre-gather instead of a live table
-        gather."""
-        mbatch = self._model_view(batch)
-        if getattr(self.model, "autodiff", False):
-            # Autodiff path (FFM, wide&deep — no reference gradient
-            # quirks): stable BCE-with-logits; d/dlogit = sigmoid - y,
-            # the same residual semantics as the explicit path.
-            def loss_fn(rows_, dense_):
-                logit_ = self.model.logit(rows_, mbatch, dense_)
-                nll = jax.nn.softplus(logit_) - mbatch["labels"] * logit_
-                return (
-                    jnp.sum(nll * mbatch["weights"]) / num_real,
-                    logit_,
-                )
-
-            (_, logit), (grad_rows, grad_dense) = jax.value_and_grad(
-                loss_fn, argnums=(0, 1), has_aux=True
-            )(rows, dense)
-            return sigmoid_ref(logit), grad_rows, (grad_dense or None)
-        logit = self.model.logit(rows, mbatch)
-        pctr = sigmoid_ref(logit)
-        # Residual "loss" exactly as the reference names it
-        # (lr_worker.cc:121-143): sigma(wx) - y, zeroed for pad
-        # examples, pre-divided by batch size for the mean-gradient
-        # semantics.
-        residual = (pctr - mbatch["labels"]) * mbatch["weights"] / num_real
-        grad_logit = self.model.grad_logit(rows, mbatch)
-        occ_grads = {
-            name: g * residual[:, None, None]
-            for name, g in grad_logit.items()
-        }
-        return pctr, occ_grads, None
+        gather.  Delegates to the module-level ``grads_from_rows`` (the
+        one forward/backward, shared with store/hot.py)."""
+        return grads_from_rows(
+            self.model, rows, dense, self._model_view(batch), num_real
+        )
 
     def _hot_keys_eff_dma(self, batch: BatchArrays) -> jax.Array:
         """Hot-plane keys sentinel-coded for a DROP-mode scatter into
@@ -1357,16 +1469,11 @@ class TrainStep:
         }, {"logloss": ll, "count": cnt}
 
     def _apply_dense_sgd(self, dense: dict, grad_dense) -> dict:
-        """Dense (MLP) params take plain SGD regardless of the table
-        optimizer (models/wide_deep.py rationale) — the ONE copy of
-        that rule, shared by _finish_step (per-dispatch application)
-        and _train_sequential (per-slice application), so the update
-        modes cannot drift apart."""
-        if not dense or grad_dense is None:
-            return dense
-        return jax.tree.map(
-            lambda p, g: p - self.cfg.sgd_lr * g, dense, grad_dense
-        )
+        """Module-level ``apply_dense_sgd`` bound to this config —
+        shared by _finish_step (per-dispatch application) and
+        _train_sequential (per-slice application), so the update modes
+        cannot drift apart."""
+        return apply_dense_sgd(dense, grad_dense, self.cfg.sgd_lr)
 
     def _finish_step(self, state, new_tables, dense, grad_dense, ll, cnt):
         """Shared step tail for the non-sequential update modes."""

@@ -81,9 +81,34 @@ def export_artifact(trainer, directory: str) -> str:
         raise RuntimeError("artifact mkdir failed on process 0")
     try:
         arrays_meta: dict[str, Any] = {}
+        store = getattr(getattr(trainer, "step", None), "store", None)
         for tname in sorted(state["tables"]):
-            arr = state["tables"][tname]["param"]
             key = f"{tname}.param"
+            if store is not None:
+                # tiered store (Config.store_mode): fold BOTH tiers
+                # into the logical [T, D] table, materialized in
+                # bounded chunks (store/tiered.py::
+                # iter_logical_param_shards) — the artifact is
+                # indistinguishable from a dense-mode export, so
+                # PredictEngine loads it unchanged
+                dim = store.cold.tables[tname].dim
+                arrays_meta[key] = {
+                    "shape": [cfg.table_size, dim],
+                    "dtype": "float32",
+                }
+                with obs.phase("export_fetch"):
+                    for start, stop, block in (
+                        store.iter_logical_param_shards(state, tname)
+                    ):
+                        np.save(
+                            os.path.join(
+                                tmp,
+                                f"{key}.r{start:012d}-{stop:012d}.npy",
+                            ),
+                            block,
+                        )
+                continue
+            arr = state["tables"][tname]["param"]
             arrays_meta[key] = {
                 "shape": list(arr.shape),
                 "dtype": str(arr.dtype),

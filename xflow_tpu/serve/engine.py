@@ -114,8 +114,16 @@ class PredictEngine:
         # digest-locked identity — is untouched) so a wire_dedup='on'
         # training config still serves on any mesh, where TrainStep's
         # single-device eligibility check would otherwise refuse it.
+        # store_mode is pinned to 'dense' the same way: a tiered
+        # artifact is exported as the FOLDED logical [T, D] table
+        # (serve/artifact.py), so serving always sees a dense store —
+        # and must not build the trainer's hot tier / cold store /
+        # promotion worker.
         self.step = TrainStep(
-            self.model, None, cfg.replace(wire_dedup="off"), self.mesh
+            self.model,
+            None,
+            cfg.replace(wire_dedup="off", store_mode="dense"),
+            self.mesh,
         )
         self.remap = remap
         self.obs = obs if obs is not None else NULL_OBS

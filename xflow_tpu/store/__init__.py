@@ -1,0 +1,43 @@
+"""Hierarchical hot/cold parameter store (ISSUE 9; docs/STORE.md).
+
+ROADMAP item 2's blocker was residency: a dense [T, D] table is ~10 GiB
+per FM table at the north-star hashed 2^28 geometry — it does not fit
+one device, and PR 7's XF010/XF014 gates exist precisely to keep jitted
+code from ever materializing at that scale.  This package is the other
+half of the answer, mirroring the hierarchical parameter-server design
+for massive ads models (arXiv:2003.05622) with the cross-replica
+sharded update discipline of arXiv:2004.13336:
+
+* ``cold.py`` — a host-resident row store: touched rows packed dense,
+  addressed by hashed key, untouched rows materialized lazily from the
+  per-row init (TableSpec.init_kind — the reference's own lazy
+  server-side init, ftrl.h:113-120).  Serialized in the
+  utils/checkpoint.py row-range shard format.
+* ``hot.py`` — the HBM-resident hot tier: ``2^hot_capacity_log2`` rows
+  per table (param + optimizer slots), row-sharded over the mesh
+  (parallel/mesh.py), plus the host-side key→slot remap and the jitted
+  hot+miss step whose every transient scales with hot capacity, never
+  T (memory-budget.json entries prove it at T=2^28).
+* ``promote.py`` — the async promotion/demotion worker: scores per-
+  batch touch counts off the critical path, proposes plans over
+  queues; the trainer applies them between steps so in-flight batches
+  never see a moving key→slot map.
+* ``tiered.py`` — the orchestrator threading the three through
+  TrainStep.put_batch (miss cold-fetch), dispatch (miss write-back),
+  checkpoint/export (both tiers folded into one logical table), and
+  the ``store`` obs row.
+"""
+
+from xflow_tpu.store.cold import ColdStore, row_init_values
+from xflow_tpu.store.hot import HotTier
+from xflow_tpu.store.promote import PromotionWorker
+from xflow_tpu.store.tiered import BatchPlan, TieredStore
+
+__all__ = [
+    "BatchPlan",
+    "ColdStore",
+    "HotTier",
+    "PromotionWorker",
+    "TieredStore",
+    "row_init_values",
+]

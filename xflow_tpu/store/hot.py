@@ -1,0 +1,341 @@
+"""HBM-resident hot tier: bounded device rows + the hot+miss step.
+
+The device never sees a table key under store_mode='tiered'.  The host
+resolves every batch key through the key→slot map (PR 5's dedup kernel
+supplies the per-batch unique set); occurrences ship as ``refs`` into a
+combined row space::
+
+    [0, Hc)        the hot tier        (param + optimizer slots,
+                                        row-sharded over the mesh)
+    [Hc, Hc+Mc)    this batch's misses (cold rows fetched by the host,
+                                        shipped with the batch)
+    Hc+Mc          the drop sentinel   (padding)
+
+and the jitted step concatenates the two blocks, gathers, computes the
+model's gradients (the ONE forward/backward, parallel/step.py::
+grads_from_rows), and applies the optimizer over the combined tier —
+dense elementwise (g=0 rows idempotent, the dense-mode argument) or
+touched-rows-only (ops/sparse.py) per Config.update_mode.  The updated
+miss block returns to the host for write-back (store/tiered.py).
+
+Every transient here is [B, K, D] or [Hc+Mc, D] shaped — hot capacity
+and batch geometry, never T.  That is the property memory-budget.json
+pins at the north-star T=2^28 (analysis rules XF010/XF014, shapeflow
+symbols Hc/M), and what makes FM/MVM/FFM trainable at full feature
+scale where only LR's D=1 table used to fit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.config import Config
+from xflow_tpu.models.base import Model
+from xflow_tpu.ops.sparse import (
+    consolidate_apply,
+    consolidate_plan,
+    gather_rows,
+    scatter_rows,
+)
+from xflow_tpu.optim.base import Optimizer
+from xflow_tpu.parallel.mesh import batch_sharding, replicated, table_sharding
+from xflow_tpu.parallel.step import apply_dense_sgd, grads_from_rows
+from xflow_tpu.utils.metrics import logloss, sigmoid_ref
+
+# Fixed promotion/demotion transfer width: fill/read always move this
+# many row slots (sentinel-padded), so the tier-maintenance jits
+# compile exactly once (XF001 discipline; shapeflow symbol P).
+PROMOTE_CAP = 1024
+
+
+class HotTier:
+    """Bounded device rows + key→slot map + the tiered jits."""
+
+    def __init__(self, model: Model, optimizer: Optimizer, cfg: Config, mesh):
+        self.model = model
+        self.optimizer = optimizer
+        self.cfg = cfg
+        self.mesh = mesh
+        self.capacity = cfg.hot_capacity
+        ndev = mesh.devices.size
+        if self.capacity % ndev:
+            raise ValueError(
+                f"hot capacity {self.capacity} not divisible by the "
+                f"mesh's {ndev} devices — pick hot_capacity_log2 >= "
+                "log2(devices)"
+            )
+        if cfg.update_mode not in ("dense", "sparse"):
+            raise ValueError(
+                "tiered store supports update_mode 'dense' or 'sparse' "
+                f"(got {cfg.update_mode!r})"
+            )
+        self._update = cfg.update_mode
+        # optimizer aux plane names (FTRL: n/z; SGD: none), discovered
+        # once from a 1-row probe
+        self._aux_names = tuple(
+            sorted(optimizer.init_aux(jnp.zeros((1, 1), jnp.float32)))
+        )
+        # key→slot remap: key_of[-1 = free] is the inverse, _free a
+        # stack of unassigned slots.  Main-thread only (the promotion
+        # worker proposes over queues; application is between steps).
+        self.key_of = np.full(self.capacity, -1, np.int64)
+        self.slot_of: dict[int, int] = {}
+        self._free: list[int] = list(range(self.capacity - 1, -1, -1))
+        # vectorized lookup snapshot (key-sorted occupied slots),
+        # rebuilt lazily: the map only mutates in maintain() (between
+        # steps), while lookup() runs per batch over every unique key
+        # — a per-key Python dict walk there would put O(uniques) of
+        # interpreter time on the serial critical path
+        self._lookup_keys = np.empty(0, np.int64)
+        self._lookup_slots = np.empty(0, np.int64)
+        self._lookup_dirty = False
+        self.train = jax.jit(self._train_impl, donate_argnums=0)
+        self.predict = jax.jit(self._predict_impl)
+        self.fill = jax.jit(self._fill_impl, donate_argnums=0)
+        self.read = jax.jit(self._read_impl)
+
+    # -- device state -------------------------------------------------------
+
+    def init_device_state(self) -> dict:
+        """Fresh [Hc, D] tier per table array (rows are garbage until a
+        slot is assigned and filled — the maps gate every read), plus
+        replicated dense params seeded exactly like the dense-mode
+        init_state (parallel/step.py) so model quality is
+        layout-independent."""
+        sharding = table_sharding(self.mesh)
+        tables: dict[str, dict[str, jax.Array]] = {}
+        for spec in self.model.tables():
+            zero = np.zeros((self.capacity, spec.dim), np.float32)
+            entry = {"param": jax.device_put(zero, sharding)}
+            for aux in self._aux_names:
+                entry[aux] = jax.device_put(zero.copy(), sharding)
+            tables[spec.name] = entry
+        dense = {}
+        if hasattr(self.model, "dense_init"):
+            rng = jax.random.PRNGKey(self.cfg.seed)
+            dense = jax.tree.map(
+                lambda a: jax.device_put(a, replicated(self.mesh)),
+                self.model.dense_init(jax.random.fold_in(rng, 1000)),
+            )
+        return {
+            "tables": tables,
+            "dense": dense,
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def batch_shardings(self):
+        return batch_sharding(self.mesh), replicated(self.mesh)
+
+    # -- key→slot map -------------------------------------------------------
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Slot per key (-1 = miss), vectorized: binary search over the
+        key-sorted occupancy snapshot."""
+        if self._lookup_dirty:
+            occ = np.flatnonzero(self.key_of >= 0)
+            hkeys = self.key_of[occ]
+            order = np.argsort(hkeys)
+            self._lookup_keys = hkeys[order]
+            self._lookup_slots = occ[order]
+            self._lookup_dirty = False
+        if not len(self._lookup_keys) or not len(keys):
+            return np.full(len(keys), -1, np.int64)
+        pos = np.searchsorted(self._lookup_keys, keys)
+        pos = np.minimum(pos, len(self._lookup_keys) - 1)
+        hit = self._lookup_keys[pos] == keys
+        return np.where(hit, self._lookup_slots[pos], -1)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> int:
+        return self.capacity - len(self._free)
+
+    def assign(self, keys) -> np.ndarray:
+        """Pop a free slot per key (caller guarantees capacity and that
+        no key is already hot)."""
+        slots = np.empty(len(keys), np.int64)
+        for i, k in enumerate(keys):
+            k = int(k)
+            s = self._free.pop()
+            self.slot_of[k] = s
+            self.key_of[s] = k
+            slots[i] = s
+        self._lookup_dirty = True
+        return slots
+
+    def release(self, keys) -> np.ndarray:
+        """Free the slots of ``keys`` (demotion — rows must already be
+        flushed to the cold store)."""
+        slots = np.empty(len(keys), np.int64)
+        for i, k in enumerate(keys):
+            k = int(k)
+            s = self.slot_of.pop(k)
+            self.key_of[s] = -1
+            self._free.append(s)
+            slots[i] = s
+        self._lookup_dirty = True
+        return slots
+
+    def reset_maps(self) -> None:
+        """Empty the tier (restore: every row re-enters through the
+        cold store and promotes again)."""
+        self.key_of.fill(-1)
+        self.slot_of.clear()
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._lookup_keys = np.empty(0, np.int64)
+        self._lookup_slots = np.empty(0, np.int64)
+        self._lookup_dirty = False
+
+    # -- compiled bodies ----------------------------------------------------
+
+    def _combined(self, tables: dict, miss: dict) -> dict:
+        """Per table: {arr: [Hc+Mc, D]} — hot tier with this batch's
+        miss block appended (the whole addressable row space of one
+        step)."""
+        return {
+            name: {
+                arr: jnp.concatenate([t[arr], miss[name][arr]])
+                for arr in t
+            }
+            for name, t in tables.items()
+        }
+
+    def _train_impl(self, tstate: dict, tbatch: dict):
+        """One tiered train step: gather over [refs], the shared
+        forward/backward, optimizer over the combined hot+miss tier,
+        split back.  Returns (new_state, miss_out, metrics)."""
+        tables = tstate["tables"]
+        dense = tstate["dense"]
+        combined = self._combined(tables, tbatch["miss"])
+        batch = {
+            "keys": tbatch["refs"],
+            "slots": tbatch["slots"],
+            "vals": tbatch["vals"],
+            "mask": tbatch["mask"],
+            "labels": tbatch["labels"],
+            "weights": tbatch["weights"],
+        }
+        num_real = jnp.maximum(jnp.sum(batch["weights"]), 1.0)
+        rows = {
+            name: c["param"][batch["keys"]] for name, c in combined.items()
+        }
+        pctr, occ_grads, grad_dense = grads_from_rows(
+            self.model, rows, dense, batch, num_real
+        )
+        # drop sentinel = one past the combined rows (same convention
+        # as ops/sparse.py / step.py's _cold_keys_eff, in ref space)
+        c = next(iter(combined.values()))["param"].shape[0]
+        refs_eff = jnp.where(
+            batch["mask"] > 0, batch["keys"], jnp.int32(c)
+        ).reshape(-1)
+        plan = (
+            consolidate_plan(refs_eff, c)
+            if self._update == "sparse"
+            else None
+        )
+        new_combined = {}
+        for name, ctab in combined.items():
+            d = ctab["param"].shape[-1]
+            occ = occ_grads[name].reshape(-1, d)
+            if plan is not None:
+                # touched-rows-only: consolidate per unique ref, then
+                # gather/update/scatter (the sparse update mode's form,
+                # ops/sparse.py — O(batch nnz) work)
+                order, seg, ukeys = plan
+                gsum = consolidate_apply(occ, order, seg)
+                state_rows = {
+                    k: gather_rows(a, ukeys) for k, a in ctab.items()
+                }
+                new_rows = self.optimizer.update_rows(state_rows, gsum)
+                new_combined[name] = {
+                    k: scatter_rows(ctab[k], ukeys, new_rows[k])
+                    for k in ctab
+                }
+            else:
+                # dense over the combined tier: scatter-add + ONE
+                # elementwise pass over [Hc+Mc, D] — hot-capacity
+                # scale, the dense mode's semantics without its [T, D]
+                # buffer (g=0 rows idempotent, optim docstrings)
+                gbuf = jnp.zeros_like(ctab["param"])
+                gbuf = gbuf.at[refs_eff].add(occ, mode="drop")
+                new_combined[name] = self.optimizer.update_rows(
+                    ctab, gbuf
+                )
+        new_tables = {
+            name: {k: a[: self.capacity] for k, a in ct.items()}
+            for name, ct in new_combined.items()
+        }
+        miss_out = {
+            name: {k: a[self.capacity :] for k, a in ct.items()}
+            for name, ct in new_combined.items()
+        }
+        new_dense = apply_dense_sgd(dense, grad_dense, self.cfg.sgd_lr)
+        metrics = {
+            "logloss": logloss(
+                batch["labels"], pctr, batch["weights"]
+            ),
+            "count": jnp.sum(batch["weights"]),
+        }
+        new_state = {
+            "tables": new_tables,
+            "dense": new_dense,
+            "step": tstate["step"] + 1,
+        }
+        return new_state, miss_out, metrics
+
+    def _predict_impl(self, tstate: dict, tbatch: dict) -> jax.Array:
+        """pctr over the combined tier (misses fetched read-only by the
+        planner — no write-back; the predict wire ships ONLY the param
+        plane per miss block, since optimizer slots never score)."""
+        batch = {
+            "keys": tbatch["refs"],
+            "slots": tbatch["slots"],
+            "vals": tbatch["vals"],
+            "mask": tbatch["mask"],
+            "labels": tbatch["labels"],
+            "weights": tbatch["weights"],
+        }
+        miss = tbatch["miss"]
+        rows = {
+            name: jnp.concatenate([t["param"], miss[name]["param"]])[
+                batch["keys"]
+            ]
+            for name, t in tstate["tables"].items()
+        }
+        if getattr(self.model, "autodiff", False):
+            logit = self.model.logit(rows, batch, tstate["dense"])
+        else:
+            logit = self.model.logit(rows, batch)
+        return sigmoid_ref(logit)
+
+    def _fill_impl(self, tstate: dict, slots: jax.Array, fill_rows: dict):
+        """Write PROMOTE_CAP rows into the tier at ``slots`` (sentinel
+        = capacity → dropped): promotion and restore warm-fill."""
+        new_tables = {
+            name: {
+                arr: scatter_rows(t[arr], slots, fill_rows[name][arr])
+                for arr in t
+            }
+            for name, t in tstate["tables"].items()
+        }
+        return {
+            "tables": new_tables,
+            "dense": tstate["dense"],
+            "step": tstate["step"],
+        }
+
+    def _read_impl(self, tstate: dict, slots: jax.Array) -> dict:
+        """Gather PROMOTE_CAP rows at ``slots``: demotion transfers.
+        Pad slots (sentinel = capacity) CLAMP to the last hot row
+        (gather mode='clip', ops/sparse.py) — callers MUST trim to the
+        real count before consuming."""
+        return {
+            name: {arr: gather_rows(t[arr], slots) for arr in t}
+            for name, t in tstate["tables"].items()
+        }

@@ -112,6 +112,22 @@ def build_parser() -> argparse.ArgumentParser:
         "on zipf batches (docs/PERF.md)",
     )
     p.add_argument(
+        "--store-mode", choices=["dense", "tiered"], dest="store_mode",
+        help="parameter residency (docs/STORE.md): dense = the whole "
+        "[T, D] table in device HBM; tiered = bounded HBM hot tier + "
+        "host cold store with async promotion — the 2^28-scale form "
+        "(FM/MVM/FFM at --table-size-log2 28 only fit this way)",
+    )
+    p.add_argument(
+        "--hot-capacity-log2", type=int, dest="hot_capacity_log2",
+        help="log2 rows of the HBM hot tier under --store-mode tiered "
+        "(must not exceed --table-size-log2)",
+    )
+    p.add_argument(
+        "--store-promote-every", type=int, dest="store_promote_every",
+        help="apply promotion/demotion plans every N train steps",
+    )
+    p.add_argument(
         "--wire-mode", choices=["auto", "full", "compact"], dest="wire_mode",
         help="host->device batch format; compact ships ~16x fewer "
         "bytes/entry (hash mode; slot-reading models add a u8 slots "

@@ -75,7 +75,16 @@ class Trainer:
         self.model = make_model(cfg)
         self.optimizer = make_optimizer(cfg)
         self.step = TrainStep(self.model, self.optimizer, cfg, self.mesh)
-        self.state = init_state(self.model, self.optimizer, cfg, self.mesh)
+        # Tiered store (Config.store_mode; store/): device state is the
+        # bounded hot tier, NOT a [T, D] table — init_state at the
+        # north-star 2^28 geometry would allocate the very buffers the
+        # store exists to avoid.
+        if self.step.store is not None:
+            self.state = self.step.store.init_device_state()
+        else:
+            self.state = init_state(
+                self.model, self.optimizer, cfg, self.mesh
+            )
         self.epoch = 0
         # (shard_idx, byte_offset) to start the next epoch from; set by
         # restore(), consumed by the first train_epoch() after it.
@@ -276,6 +285,11 @@ class Trainer:
         for it in list(self._live_prefetch):
             it.close()
         self._live_prefetch.clear()
+        if self.step.store is not None:
+            # flush the pending miss write-back and reap the promotion
+            # worker (bounded join; a leak lands as a health row before
+            # the metrics logger closes below)
+            self.step.store.close()
         if (
             self._flight is not None
             and self._flight_reason is not None
@@ -632,8 +646,12 @@ class Trainer:
             vote_preempt=True,
         )
         # single-host: overlap host->device transfer with device compute
-        # (multi-host keeps put_batch on the voting thread — collective)
-        ahead = self.num_hosts == 1
+        # (multi-host keeps put_batch on the voting thread — collective;
+        # the tiered store pins the ring OFF so the cold store keeps
+        # read-your-writes order — a ring worker planning batch N+1
+        # would otherwise cold-fetch keys whose batch-N write-back is
+        # still in flight; docs/STORE.md "Ordering")
+        ahead = self.num_hosts == 1 and self.step.store is None
         if ahead:
             stream = self._transfer_ahead(stream)
             # reaped below on the normal path; by Trainer.close() when
@@ -672,6 +690,16 @@ class Trainer:
                 steps += 1
                 self._global_steps += 1
                 device_metrics.append(metrics)
+                if self.step.store is not None and (
+                    steps % cfg.store_promote_every == 0
+                ):
+                    # between-steps tier maintenance: flush the miss
+                    # write-back, apply the promotion worker's plan
+                    # (store/tiered.py::maintain — in-flight batches
+                    # never see a moving key->slot map)
+                    self.state = self.step.store.maintain(
+                        self.state, obs=obs
+                    )
                 if profiling and self._global_steps >= profile_end:
                     self._stop_profile(metrics)
                     profiling = False
@@ -692,6 +720,10 @@ class Trainer:
                 self._stop_profile(
                     device_metrics[-1] if device_metrics else None
                 )
+            if self.step.store is not None:
+                # epoch-end flush: the LAST step's miss write-back must
+                # land before eval/save/export reads the cold store
+                self.state = self.step.store.maintain(self.state, obs=obs)
             self._pulse("device_block")
             with obs.phase("device_block"):
                 host_metrics = jax.device_get(device_metrics)
@@ -778,6 +810,36 @@ class Trainer:
                     occ_in / touched if touched else 1.0, 3
                 ),
             }
+        if "store.hit_occ" in snap.counters or (
+            "store.miss_occ" in snap.counters
+        ):
+            # tiered-store accounting (store/tiered.py::plan_batch +
+            # maintain) -> the epoch's `store` metrics row; hit rate is
+            # occurrence-weighted (the share of feature occurrences the
+            # hot tier served without a cold fetch)
+            hits = snap.counters.get("store.hit_occ", 0)
+            misses = snap.counters.get("store.miss_occ", 0)
+            stats["_store"] = {
+                "epoch": self.epoch,
+                "hot_hit_rate": round(
+                    hits / max(hits + misses, 1), 6
+                ),
+                "promotions": int(
+                    snap.counters.get("store.promotions", 0)
+                ),
+                "demotions": int(
+                    snap.counters.get("store.demotions", 0)
+                ),
+                "cold_fetch_seconds": round(
+                    snap.counters.get("store.cold_fetch_seconds", 0.0), 6
+                ),
+                "hot_occupancy": round(
+                    self.step.store.occupancy_frac()
+                    if self.step.store is not None
+                    else 0.0,
+                    6,
+                ),
+            }
         if "loader.parse_bytes" in snap.counters:
             stats["parse_mb_per_sec"] = round(
                 snap.counters["loader.parse_bytes"] / 2**20
@@ -805,11 +867,14 @@ class Trainer:
                 self._resume_cursor = (0, 0)
                 stats = self.train_epoch(start_shard, start_offset)
                 wire_stats = stats.pop("_wire", None)
+                store_stats = stats.pop("_store", None)
                 history.append(stats)
                 if self.metrics_logger is not None:
                     self.metrics_logger.log("train_epoch", stats)
                     if wire_stats is not None:
                         self.metrics_logger.log("wire", wire_stats)
+                    if store_stats is not None:
+                        self.metrics_logger.log("store", store_stats)
                 self._log_device_mem()
                 if self.epoch % 30 == 0 or self.epoch == self.cfg.epochs - 1:
                     self._log(
@@ -955,7 +1020,9 @@ class Trainer:
                 except StopIteration:
                     break
                 self._pulse("h2d")
-                arrays = self.step.put_batch(batch)  # books 'h2d' inline
+                # books 'h2d' inline; predict=True lets the tiered
+                # store ship param-only miss blocks
+                arrays = self.step.put_batch(batch, predict=True)
                 self._pulse("dispatch")
                 with obs.phase("dispatch"):
                     garr = self.step.predict(self.state, arrays)
@@ -1082,13 +1149,24 @@ class Trainer:
             "shard": cursors[0]["shard"],
             "offset": cursors[0]["offset"],
         }
-        path = save_checkpoint(
-            self.cfg.checkpoint_dir,
-            self.state,
-            cursor,
-            self.cfg.to_json(),
-            keep=self.cfg.checkpoint_keep,
-        )
+        if self.step.store is not None:
+            # tier-erased fold (store/tiered.py): touched rows from
+            # BOTH tiers, key-sorted, in the row-range shard format
+            path = self.step.store.save_checkpoint(
+                self.cfg.checkpoint_dir,
+                self.state,
+                cursor,
+                self.cfg.to_json(),
+                keep=self.cfg.checkpoint_keep,
+            )
+        else:
+            path = save_checkpoint(
+                self.cfg.checkpoint_dir,
+                self.state,
+                cursor,
+                self.cfg.to_json(),
+                keep=self.cfg.checkpoint_keep,
+            )
         if self._flight is not None:
             self._flight.note_checkpoint(self._global_steps)
         # close the 'checkpoint' activity: after a post-epoch save the
@@ -1112,7 +1190,12 @@ class Trainer:
         from xflow_tpu.utils.checkpoint import IncompatibleCheckpoint
 
         try:
-            self.state, cursor = load_checkpoint(path, self.state)
+            if self.step.store is not None:
+                self.state, cursor = self.step.store.load_checkpoint(
+                    path, self.state
+                )
+            else:
+                self.state, cursor = load_checkpoint(path, self.state)
         except IncompatibleCheckpoint as e:
             self._log(f"ignoring unusable checkpoint: {e} — starting fresh")
             return None

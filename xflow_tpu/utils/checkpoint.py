@@ -299,6 +299,12 @@ def load_checkpoint(
             f"checkpoint {path} has unsupported format "
             f"{manifest.get('format')!r} (expected 2)"
         )
+    if "store" in manifest:
+        raise IncompatibleCheckpoint(
+            f"checkpoint {path} was written by store_mode='tiered' "
+            "(tier-erased fold; store/tiered.py) — set "
+            "store_mode='tiered' to restore it"
+        )
 
     new_tables: dict[str, Any] = {}
     for tname, table in state["tables"].items():
