@@ -829,3 +829,56 @@ def test_bench_regress_degraded_baseline_skipped(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "every prior bench artifact is degraded" in out
+
+
+def test_doctor_shed_storm_and_canary_stuck(tmp_path, capsys):
+    """Serving-tier forensics (ISSUE 10): a serve_shed window where
+    admission control rejected most traffic reads as shed_storm and is
+    blamed on capacity — explicitly naming any serve_queue_stall trips
+    as the same condition — and a rollout stream that ends on its
+    open-rollout heartbeat reads as canary_stuck.  A resolved rollout
+    and a quiet shed window stay clean."""
+    from xflow_tpu.obs.__main__ import main
+
+    def shed_row(frac, total):
+        return {
+            "t": 2.0, "kind": "serve_shed", "admitted": total * 2,
+            "shed_total": total, "shed_frac": frac,
+            "by_cause": {"queue_age": total}, "errors": 0,
+            "depth": 12, "queue_age_s": 0.3,
+        }
+
+    def rollout_row(event):
+        return {
+            "t": 3.0, "kind": "rollout", "event": event,
+            "from_digest": "aaa", "to_digest": "bbb",
+            "canary_frac": 0.25, "canary_requests": 40,
+            "canary_errors": 0, "detail": "",
+        }
+
+    stall = {
+        "t": 1.0, "kind": "health", "cause": "serve_queue_stall",
+        "channel": "serve", "silence_seconds": 2.0,
+        "threshold_seconds": 0.5, "detail": "batch", "channels": {},
+    }
+    m = tmp_path / "storm.jsonl"
+    m.write_text("\n".join(json.dumps(r) for r in [
+        _run_header(0), stall, shed_row(0.8, 80),
+        rollout_row("begin"), rollout_row("canary"),
+    ]) + "\n")
+    rc = main(["doctor", str(m)])
+    text = capsys.readouterr().out
+    assert rc == 1
+    assert "shed_storm" in text and "80%" in text
+    assert "same capacity condition" in text  # not misread as a queue bug
+    assert "canary_stuck" in text and "'canary'" in text
+
+    # resolved rollout + sub-threshold shedding: serving checks clean
+    m.write_text("\n".join(json.dumps(r) for r in [
+        _run_header(0), shed_row(0.02, 4),
+        rollout_row("begin"), rollout_row("commit"),
+    ]) + "\n")
+    assert main(["doctor", str(m)]) == 0
+    text = capsys.readouterr().out
+    # finding-code form: the tmp dir name itself contains "shed_storm"
+    assert "shed_storm:" not in text and "canary_stuck:" not in text

@@ -7,6 +7,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -500,3 +501,722 @@ def test_check_serve_smoke_script():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "OK" in proc.stdout
+
+
+# -- production tier (ISSUE 10): fleet, admission, rollout, HTTP, SLO -------
+
+
+def _http_json(url, doc=None, method=None, timeout=30.0):
+    """(status, parsed body) for a JSON request — 4xx/5xx included."""
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(doc).encode() if doc is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method or ("POST" if doc is not None else "GET"),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}"), dict(e.headers)
+
+
+def _slowed(engine, delay_s):
+    """Wrap the engine's device call in a sleep — the injected-latency
+    regression used by admission/backpressure/SLO tests."""
+    import time as _time
+
+    orig = engine.predict_prepared
+    engine.predict_prepared = lambda b: (_time.sleep(delay_s), orig(b))[1]
+    return engine
+
+
+def test_fleet_replicas_share_weights_and_compiles(lr_served):
+    """ReplicaFleet fans ONE loaded artifact out to N clones: shared
+    state dict, shared AOT executables (one compile set fleet-wide),
+    per-replica batchers; routed scores match direct engine predict."""
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+
+    engine = PredictEngine.load(lr_served["artifact"], buckets=(8,), warm=True)
+    fleet = ReplicaFleet(engine, replicas=3, max_wait_ms=1.0)
+    try:
+        assert fleet.replicas == 3
+        assert fleet.engines[1].state is fleet.engines[0].state
+        assert fleet.engines[2]._compiled is fleet.engines[0]._compiled
+        assert fleet.engines[1].compile_count == 1  # shared, not 3x
+        rng = np.random.default_rng(3)
+        rows = [
+            rng.integers(0, engine.cfg.table_size, size=6)
+            for _ in range(30)
+        ]
+        futs = [fleet.submit(r) for r in rows]
+        got = np.asarray([f.result(timeout=60) for f in futs])
+        np.testing.assert_allclose(
+            got, engine.predict(engine.featurize_raw(rows)), atol=1e-6
+        )
+        live = fleet.stats()
+        assert live["replicas"] == 3
+        assert live["shed"]["admitted"] == 30
+        assert live["stats"]["requests"] == 30
+        assert live["rollout"] is None
+    finally:
+        final = fleet.close()
+    assert fleet.close() == final  # idempotent, same final rows
+    with pytest.raises(RuntimeError, match="closed"):
+        fleet.submit(rows[0])
+
+
+def test_fleet_admission_sheds_typed_and_counts(lr_served, tmp_path):
+    """Admission control: a backlog past the depth/deadline budget
+    rejects with a TYPED ShedError (cause queue_depth/queue_age),
+    counted per cause in the serve_shed row; admitted requests all
+    still score."""
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet, ShedError
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    engine = _slowed(
+        PredictEngine.load(lr_served["artifact"], buckets=(8,), warm=True),
+        0.2,
+    )
+    out = tmp_path / "shed.jsonl"
+    logger = MetricsLogger(out, run_header={
+        "run_id": "t", "config_digest": engine.digest,
+        "rank": 0, "num_hosts": 1,
+    })
+    fleet = ReplicaFleet(
+        engine, replicas=1, max_wait_ms=0.0,
+        deadline_budget_ms=10.0, depth_budget=2,
+        metrics_logger=logger,
+    )
+    rng = np.random.default_rng(4)
+    row = rng.integers(0, engine.cfg.table_size, size=5)
+    futs, sheds = [], []
+    for _ in range(20):
+        try:
+            futs.append(fleet.submit(row))
+        except ShedError as e:
+            sheds.append(e)
+    assert sheds, "a 0.2s device call never backed the queue up?"
+    assert {e.cause for e in sheds} <= {"queue_depth", "queue_age"}
+    assert all(e.depth >= 0 and e.queue_age_s >= 0 for e in sheds)
+    got = [f.result(timeout=60) for f in futs]  # admitted all score
+    assert len(got) == len(futs)
+    final = fleet.close()
+    logger.close()
+    shed_row = final["shed"]
+    assert shed_row["shed_total"] == len(sheds)
+    assert sum(shed_row["by_cause"].values()) == len(sheds)
+    assert shed_row["admitted"] == len(futs)
+    assert final["stats"]["shed_total"] == len(sheds)  # satellite: stats()
+    rows_jsonl = load_jsonl(str(out))
+    assert validate_rows(rows_jsonl) == []
+    kinds = [r["kind"] for r in rows_jsonl]
+    assert "serve_shed" in kinds and "serve_stats" in kinds
+
+
+def test_rollout_mid_traffic_never_mixes_artifacts(toy_dataset, tmp_path):
+    """Tentpole acceptance: a staged rollout under concurrent live
+    traffic never mixes two artifacts in one coalesced batch — every
+    scored value matches exactly artifact A or artifact B, the stream
+    converges on B after commit, and zero requests fail."""
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    trainer = Trainer(_cfg(toy_dataset, epochs=1))
+    trainer.train()
+    art_a = str(tmp_path / "a")
+    export_artifact(trainer, art_a)
+    trainer.train_epoch()
+    art_b = str(tmp_path / "b")
+    export_artifact(trainer, art_b)
+
+    ea = PredictEngine.load(art_a, buckets=(8,), warm=True)
+    eb = PredictEngine.load(art_b, buckets=(8,), warm=True)
+    first = _raw_batches(trainer, trainer.cfg.test_path + "-00000")[0]
+    row = first.keys[0][first.mask[0] > 0]  # trained keys: pa != pb
+    pa = float(ea.predict(ea.featurize_raw([row]))[0])
+    pb = float(eb.predict(eb.featurize_raw([row]))[0])
+    assert pa != pb
+
+    out = tmp_path / "rollout.jsonl"
+    logger = MetricsLogger(out, run_header={
+        "run_id": "t", "config_digest": ea.digest,
+        "rank": 0, "num_hosts": 1,
+    })
+    fleet = ReplicaFleet(
+        ea, replicas=2, max_wait_ms=1.0, metrics_logger=logger
+    )
+    results: list[float] = []
+    failures: list[BaseException] = []
+    stop = threading.Event()
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                results.append(fleet.score(row, timeout=60))
+            except BaseException as e:  # noqa: BLE001 - recorded, asserted
+                failures.append(e)
+                return
+
+    threads = [threading.Thread(target=traffic) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        state = fleet.begin_rollout(
+            eb, canary_frac=0.5, min_canary_requests=8
+        )
+        assert state["canary_requests"] == 0
+        deadline = time.perf_counter() + 30
+        while time.perf_counter() < deadline:
+            state = fleet.rollout_state()
+            if state["healthy"]:
+                break
+            time.sleep(0.01)
+        assert state["healthy"], f"canary never reached the gate: {state}"
+        fleet.emit_stats()  # flushes the open-rollout 'canary' heartbeat
+        health = fleet.commit_rollout()
+        assert health["canary_errors"] == 0
+        assert fleet.rollout_state() is None
+        assert fleet.digest == eb.digest
+        n_at_commit = len(results)
+        while len(results) < n_at_commit + 8 and not failures:
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        fleet.close()
+        logger.close()
+    assert not failures, failures
+    # every value is EXACTLY one artifact's score — never a blend of
+    # two engines inside one coalesced batch
+    for p in results:
+        assert p == pytest.approx(pa, abs=1e-6) or p == pytest.approx(
+            pb, abs=1e-6
+        ), f"scored {p}, which is neither artifact a ({pa}) nor b ({pb})"
+    assert results[-1] == pytest.approx(pb, abs=1e-6)  # converged on B
+    rows_jsonl = load_jsonl(str(out))
+    assert validate_rows(rows_jsonl) == []
+    events = [r["event"] for r in rows_jsonl if r["kind"] == "rollout"]
+    assert events == ["begin", "canary", "commit"]
+
+
+def test_rollout_digest_guard_and_health_gate(lr_served, toy_dataset, tmp_path):
+    """Rollout discipline: a digest-mismatched candidate is refused
+    BEFORE any traffic shifts; commit is refused until the canary
+    health gate passes; abort restores the incumbent; double-open is
+    an error."""
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet, RolloutError
+
+    fleet = ReplicaFleet.load(lr_served["artifact"], replicas=2, buckets=(8,))
+    try:
+        other = Trainer(_cfg(toy_dataset, epochs=1, alpha=0.9))
+        art_c = str(tmp_path / "c")
+        export_artifact(other, art_c)
+        ec = PredictEngine.load(art_c, buckets=(8,), warm=False)
+        with pytest.raises(ValueError, match="redeploy"):
+            fleet.begin_rollout(ec)
+        assert fleet.rollout_state() is None  # no traffic ever shifted
+        # same-digest artifact path (str → _load_candidate loads it)
+        fleet.begin_rollout(
+            lr_served["artifact"], canary_frac=0.25, min_canary_requests=5
+        )
+        with pytest.raises(RolloutError, match="already open"):
+            fleet.begin_rollout(lr_served["artifact"])
+        with pytest.raises(RolloutError, match="not healthy"):
+            fleet.commit_rollout()  # 0 canary requests < gate
+        health = fleet.abort_rollout(detail="test")
+        assert health["canary_requests"] == 0
+        assert fleet.rollout_state() is None
+        with pytest.raises(RolloutError, match="no rollout open"):
+            fleet.abort_rollout()
+    finally:
+        fleet.close()
+
+
+def test_http_tier_endpoints_and_graceful_close(lr_served):
+    """The HTTP front end: healthz/stats/score (JSON + packed wire)
+    against a live 2-replica fleet; scores match direct engine
+    predict; close() drains and is idempotent; the accept loop beats
+    the flight recorder's http channel."""
+    from xflow_tpu.obs.flight import FlightRecorder
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.server import (
+        ServeTier,
+        decode_packed_response,
+        encode_packed_request,
+    )
+
+    engine = PredictEngine.load(lr_served["artifact"], buckets=(8,), warm=True)
+    fl = FlightRecorder()
+    fleet = ReplicaFleet(engine, replicas=2, max_wait_ms=1.0, flight=fl)
+    tier = ServeTier(fleet, port=0, flight=fl, poll_s=0.05).start()
+    try:
+        assert tier.running
+        status, health, _ = _http_json(tier.address + "/healthz")
+        assert status == 200
+        assert health["status"] == "serving"
+        assert health["digest"] == engine.digest
+        assert health["replicas"] == 2
+
+        rng = np.random.default_rng(5)
+        rows = [
+            rng.integers(0, engine.cfg.table_size, size=4) for _ in range(5)
+        ]
+        want = engine.predict(engine.featurize_raw(rows))
+        status, doc, _ = _http_json(tier.address + "/v1/score", {
+            "rows": [{"keys": [int(k) for k in r]} for r in rows],
+        })
+        assert status == 200
+        np.testing.assert_allclose(doc["pctr"], want, atol=1e-5)
+        assert doc["digest"] == engine.digest
+
+        # packed-binary wire, same scoring path
+        import urllib.request
+
+        req = urllib.request.Request(
+            tier.address + "/v1/score_packed",
+            data=encode_packed_request([(r, None, None) for r in rows]),
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            packed = decode_packed_response(r.read())
+        np.testing.assert_allclose(packed, want, atol=1e-6)
+
+        status, stats, _ = _http_json(tier.address + "/v1/stats")
+        assert status == 200
+        assert stats["shed"]["admitted"] == 10  # 5 JSON + 5 packed
+        status, _, _ = _http_json(tier.address + "/nope")
+        assert status == 404
+        status, err, _ = _http_json(tier.address + "/v1/score", {"bad": 1})
+        assert status == 400
+        # the accept loop heartbeats the http channel every poll
+        assert fl.beat_age("http") is not None
+    finally:
+        final = tier.close()
+    assert not tier.running
+    assert tier.close() == final  # idempotent
+    # stats() is non-destructive, so the final close-time flush still
+    # owns the whole window
+    assert final["shed"]["admitted"] == 10
+
+
+def test_http_backpressure_typed_429(lr_served):
+    """An admission-control shed surfaces as HTTP 429 with the typed
+    cause + Retry-After header, while admitted requests still answer
+    200 — clients can tell 'slow down' from 'broken'."""
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.server import ServeTier
+
+    engine = _slowed(
+        PredictEngine.load(lr_served["artifact"], buckets=(8,), warm=True),
+        0.3,
+    )
+    fleet = ReplicaFleet(
+        engine, replicas=1, max_wait_ms=0.0,
+        deadline_budget_ms=15.0, depth_budget=1,
+    )
+    tier = ServeTier(fleet, port=0, poll_s=0.05).start()
+    statuses: list[tuple[int, dict, dict]] = []
+    lock = threading.Lock()
+
+    def hit():
+        out = _http_json(
+            tier.address + "/v1/score", {"keys": [1, 2, 3]}, timeout=60
+        )
+        with lock:
+            statuses.append(out)
+
+    try:
+        threads = [threading.Thread(target=hit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        tier.close()
+    codes = sorted(s for s, _, _ in statuses)
+    assert 200 in codes and 429 in codes, codes
+    shed = next(doc for s, doc, _ in statuses if s == 429)
+    assert shed["error"] == "backpressure"
+    assert shed["cause"] in ("queue_depth", "queue_age")
+    assert shed["retry_after_ms"] >= 1
+    hdrs = next(h for s, _, h in statuses if s == 429)
+    assert "Retry-After" in hdrs
+
+
+def test_loadgen_slo_gate_healthy_and_regressed(lr_served, tmp_path):
+    """Satellite (CI wiring): a healthy open-loop zipf loadgen run
+    passes scripts/check_serve_slo.py; an injected latency regression
+    (slow device + tight deadline budget → shed storm, fat p99) exits
+    non-zero.  The serve_bench row and fleet windows all validate."""
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.loadgen import run_loadgen
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gate = os.path.join(repo, "scripts", "check_serve_slo.py")
+
+    def run(metrics_path, delay_s, deadline_ms, qps):
+        engine = PredictEngine.load(
+            lr_served["artifact"], buckets=(8, 64), warm=True
+        )
+        if delay_s:
+            _slowed(engine, delay_s)
+        logger = MetricsLogger(metrics_path, run_header={
+            "run_id": "t", "config_digest": engine.digest,
+            "rank": 0, "num_hosts": 1,
+        })
+        fleet = ReplicaFleet(
+            engine, replicas=2, max_wait_ms=1.0,
+            deadline_budget_ms=deadline_ms, metrics_logger=logger,
+        )
+        try:
+            summary = run_loadgen(
+                fleet, offered_qps=qps, duration_s=1.2, concurrency=4,
+                nnz=6, seed=2, drain_timeout_s=30.0,
+                metrics_logger=logger,
+            )
+        finally:
+            fleet.close()
+            logger.close()
+        assert validate_rows(load_jsonl(str(metrics_path))) == []
+        return summary
+
+    healthy = tmp_path / "healthy.jsonl"
+    summary = run(healthy, delay_s=0.0, deadline_ms=200.0, qps=100)
+    assert summary["errors"] == 0
+    assert summary["outstanding"] == 0
+    assert summary["per_bucket"], "per-bucket e2e percentiles missing"
+    assert summary["compiles"] == 2  # fleet-wide, shared executables
+    proc = subprocess.run(
+        [sys.executable, gate, str(healthy), "--max-shed-frac", "0.3"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+    regressed = tmp_path / "regressed.jsonl"
+    summary = run(regressed, delay_s=0.15, deadline_ms=20.0, qps=100)
+    assert summary["shed_frac"] > 0.3, summary  # the storm happened
+    proc = subprocess.run(
+        [
+            sys.executable, gate, str(regressed),
+            "--max-shed-frac", "0.3", "--max-p99-ms", "100",
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "FAIL" in proc.stdout + proc.stderr
+
+    # a black-holed request (admitted, never resolved before the drain
+    # timeout) is neither an error nor a shed — the outstanding gate
+    # must refuse it by default
+    rows = [json.loads(l) for l in open(healthy) if l.strip()]
+    bench = next(r for r in rows if r.get("kind") == "serve_bench")
+    bench["outstanding"] = 7
+    blackhole = tmp_path / "blackhole.jsonl"
+    blackhole.write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, gate, str(blackhole), "--max-shed-frac", "0.3"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "outstanding" in proc.stdout
+
+    # a file with no serve_bench rows is a usage error, not a pass
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    proc = subprocess.run(
+        [sys.executable, gate, str(empty)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+
+    # a closed-loop `bench` row (no offered_qps_actual) must be
+    # refused as usage error, not gate-pass vacuously on defaults
+    for r in rows:
+        r.pop("offered_qps_actual", None)
+    benchonly = tmp_path / "benchonly.jsonl"
+    benchonly.write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, gate, str(benchonly)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "offered_qps_actual" in proc.stderr
+
+
+def test_loadgen_failed_stripe_is_not_a_clean_run(lr_served, monkeypatch):
+    """A worker whose row pre-generation dies must book its arrivals as
+    failed requests (error_frac fails the gate), not silently vanish
+    and leave a gate-passing summary over traffic never sent."""
+    from xflow_tpu.serve import loadgen as lg
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+
+    with pytest.raises(ValueError, match="zipf_a"):
+        lg.run_loadgen(object(), offered_qps=10, duration_s=1,
+                       zipf_a=1.0, table_size=64)
+
+    real = lg.zipf_rows
+    calls = []
+
+    def flaky(rng, n, **kw):
+        calls.append(n)
+        if len(calls) == 1:  # first stripe to generate dies
+            raise MemoryError("synthetic generation failure")
+        return real(rng, n, **kw)
+
+    monkeypatch.setattr(lg, "zipf_rows", flaky)
+    engine = PredictEngine.load(
+        lr_served["artifact"], buckets=(8, 64), warm=True
+    )
+    fleet = ReplicaFleet(engine, replicas=1)
+    try:
+        summary = lg.run_loadgen(
+            fleet, offered_qps=40, duration_s=0.5, concurrency=4,
+            nnz=6, seed=3,
+        )
+    finally:
+        fleet.close()
+    # the dead stripe's share of offered traffic is booked as errors
+    assert summary["errors"] >= 4, summary
+    assert summary["outstanding"] == 0, summary
+    assert summary["requests"] + summary["errors"] >= 20, summary
+
+
+def test_watchdog_http_channel_accept_stall():
+    """The watchdog classifies http-channel silence (a wedged accept
+    loop) as serve_accept_stall — independently of the serve channel —
+    and only while the tier's pending probe says it should be alive."""
+    import time as _time
+
+    from xflow_tpu.obs.flight import FlightRecorder
+    from xflow_tpu.obs.watchdog import Watchdog
+
+    fl = FlightRecorder()
+    wd = Watchdog(fl, input_s=60.0, device_s=60.0, serve_s=0.05)
+    alive = {"running": True}
+    wd.set_pending("http", lambda: alive["running"])
+    fl.note_http("accept")
+    _time.sleep(0.1)
+    rows = wd.check()
+    assert [r["cause"] for r in rows] == ["serve_accept_stall"]
+    assert rows[0]["channel"] == "http"
+    # a fresh beat recovers the incident with the stall duration
+    fl.note_http("accept")
+    rows = wd.check()
+    assert [r["cause"] for r in rows] == ["recovered:serve_accept_stall"]
+    # after close() the probe goes False: silence is a stopped server
+    alive["running"] = False
+    _time.sleep(0.1)
+    assert wd.check() == []
+
+
+def test_serve_cli_sigterm_graceful_drain(lr_served, tmp_path):
+    """Satellite: `python -m xflow_tpu.serve serve` comes up, serves
+    scoring traffic over HTTP, and drains gracefully on SIGTERM
+    through the tier/fleet close() path — exit 0, final stats rows
+    flushed and schema-valid."""
+    import signal
+    import urllib.request
+
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+
+    metrics = tmp_path / "serve.jsonl"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "xflow_tpu.serve", "serve",
+            lr_served["artifact"], "--port", "0", "--replicas", "2",
+            "--buckets", "8", "--canary-frac", "0.2",
+            "--stats-every-s", "0.5", "--metrics-out", str(metrics),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        line = proc.stdout.readline()
+        hello = json.loads(line)
+        assert hello["replicas"] == 2
+        url = hello["serving"]
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+            assert json.loads(r.read())["status"] == "serving"
+        req = urllib.request.Request(
+            url + "/v1/score",
+            data=json.dumps({"keys": [3, 99, 2048]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            pctr = json.loads(r.read())["pctr"]
+        assert len(pctr) == 1 and 0.0 < pctr[0] < 1.0
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+        assert proc.returncode == 0, out + err
+        assert "drained" in out.splitlines()[-1]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+    rows_jsonl = load_jsonl(str(metrics))
+    assert validate_rows(rows_jsonl) == []
+    kinds = [r["kind"] for r in rows_jsonl]
+    assert "serve_load" in kinds
+    assert "serve_stats" in kinds and "serve_shed" in kinds
+
+
+def test_forced_redeploy_rollout_commits_and_stripes(
+    lr_served, toy_dataset, tmp_path
+):
+    """A forced begin (different config digest — a redeploy) carries
+    its force through commit: the non-canary replicas still run the
+    OLD digest at commit time, so an unforced commit-side swap would
+    raise mid-fleet (and, on the auto-commit path, unwind the accept
+    loop).  Also pins interleaved canary striping: at canary_frac=0.5
+    the canary sees every OTHER request, not a contiguous burst."""
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+
+    fleet = ReplicaFleet.load(lr_served["artifact"], replicas=2, buckets=(8,))
+    try:
+        other = Trainer(_cfg(toy_dataset, epochs=1, alpha=0.9))
+        art_c = str(tmp_path / "c")
+        export_artifact(other, art_c)
+        ec = PredictEngine.load(art_c, buckets=(8,), warm=False)
+        assert ec.digest != fleet.digest
+        fleet.begin_rollout(
+            ec, canary_frac=0.5, min_canary_requests=4, force=True
+        )
+        for _ in range(8):
+            fleet.score([3, 99, 2048])
+        deadline = time.perf_counter() + 10
+        while time.perf_counter() < deadline:
+            n = fleet.rollout_state()["canary_requests"]
+            if n >= 4:
+                break
+            time.sleep(0.01)
+        # Bresenham striping: exactly every 2nd of 8 requests
+        assert n == 4, n
+        health = fleet.commit_rollout()  # no force arg: ro carries it
+        assert health["canary_errors"] == 0
+        assert fleet.digest == ec.digest
+        for e in fleet.engines:
+            assert e.digest == ec.digest
+    finally:
+        fleet.close()
+
+
+def test_tier_close_without_start_is_bounded(lr_served):
+    """close() on a tier whose accept loop never started must not
+    block on the serve_forever shutdown handshake (the is-shut-down
+    event only ever sets inside serve_forever) — the cleanup path for
+    an exception between construction and start()."""
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.server import ServeTier
+
+    fleet = ReplicaFleet.load(lr_served["artifact"], replicas=1, buckets=(8,))
+    tier = ServeTier(fleet, port=0)
+    done: list[dict] = []
+    t = threading.Thread(target=lambda: done.append(tier.close()))
+    t.start()
+    t.join(timeout=20)
+    assert not t.is_alive(), "close() hung on a never-started tier"
+    assert "shed" in done[0]
+    with pytest.raises(RuntimeError, match="closed"):
+        tier.start()
+
+
+def test_http_malformed_score_bodies_are_400(lr_served):
+    """Client-shaped garbage is a 400, not a 500: a JSON array body,
+    non-object rows, and non-JSON all name the problem instead of
+    surfacing an internal TypeError."""
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.server import ServeTier
+
+    engine = PredictEngine.load(lr_served["artifact"], buckets=(8,), warm=False)
+    fleet = ReplicaFleet(engine, replicas=1)
+    tier = ServeTier(fleet, port=0, poll_s=0.05).start()
+    try:
+        url = tier.address + "/v1/score"
+        code, doc, _ = _http_json(url, [{"keys": [1, 2]}])
+        assert code == 400 and "JSON object" in doc["error"]
+        code, doc, _ = _http_json(url, {"rows": [[1, 2]]})
+        assert code == 400 and "row" in doc["error"]
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            url, data=b"not json",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                code = r.status
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 400
+        # the tier still serves after the garbage
+        code, doc, _ = _http_json(url, {"keys": [3, 99]})
+        assert code == 200 and len(doc["pctr"]) == 1
+    finally:
+        tier.close()
+
+
+def test_route_striping_starves_no_replica_and_gates_ignore_stragglers(
+    lr_served,
+):
+    """Two routing invariants under an open rollout: (1) the
+    non-canary round-robin uses its own counter, so at canary_frac=0.5
+    on a 3-replica fleet BOTH non-canary replicas receive traffic
+    (a _seq-indexed round-robin stays phase-locked with the stripe and
+    starves one); (2) canary health counts only completions routed by
+    THIS rollout — a straggler carrying a resolved rollout's token
+    never feeds the gate of the one that replaced it."""
+    from concurrent.futures import Future
+
+    from xflow_tpu.serve.fleet import ReplicaFleet
+
+    fleet = ReplicaFleet.load(lr_served["artifact"], replicas=3, buckets=(8,))
+    try:
+        fleet.begin_rollout(
+            lr_served["artifact"], canary_frac=0.5, min_canary_requests=4
+        )
+        routes = [fleet._route() for _ in range(24)]
+        canary_hits = sum(1 for _, ro in routes if ro is not None)
+        others_hit = {i for i, ro in routes if ro is None}
+        assert canary_hits == 12, routes
+        assert others_hit == {1, 2}, others_hit  # nobody starves
+        ro_a = fleet._rollout
+        fleet.abort_rollout(detail="test")
+        fleet.begin_rollout(
+            lr_served["artifact"], canary_frac=0.5, min_canary_requests=4
+        )
+        f: Future = Future()
+        f.set_result(0.5)
+        fleet._done(f, time.perf_counter(), ro_a)  # straggler from A
+        assert fleet.rollout_state()["canary_requests"] == 0
+        fleet.abort_rollout(detail="test")
+    finally:
+        fleet.close()

@@ -19,6 +19,10 @@ diagnosis instead of raw JSONL:
 * per-rank step-time skew → straggler host callout (merged streams);
 * step-time shape → bimodality (p99 ≫ p50 while p90 stays near p50)
   as recompile suspicion;
+* serving tier → shed-storm windows (``serve_shed`` rows where
+  admission control rejected most offered traffic — blamed on
+  capacity, explicitly NOT on the queue) and canary-stuck rollouts
+  (a ``rollout`` stream that ends on ``begin``/``canary``);
 * bench artifact → degraded-bench detection (``degraded: true``).
 
 Severity ranks ``crit`` > ``warn`` > ``info``; the CLI exits 0 only
@@ -58,6 +62,11 @@ BIMODAL_MIN_EXCESS_S = 0.025
 # while promotions/demotions keep churning — the working set does not
 # fit the configured hot capacity.
 STORE_THRASH_HIT_RATE = 0.5
+# shed storm: a ``serve_shed`` window (serve/fleet.py admission
+# control) where rejections dominate offered traffic.  The floor on
+# absolute sheds keeps a 3-request toy window from reading as a storm.
+SHED_STORM_FRAC = 0.5
+SHED_STORM_MIN_TOTAL = 20
 
 _SEV_ORDER = {"crit": 0, "warn": 1, "info": 2}
 
@@ -327,6 +336,71 @@ def _check_store(rows: list[dict]) -> list[Diagnosis]:
     )]
 
 
+def _check_serve(
+    rows: list[dict], queue_stall_tripped: bool = False
+) -> list[Diagnosis]:
+    """Serving-tier health from the fleet's ``serve_shed`` and
+    ``rollout`` rows (serve/fleet.py, docs/SERVING.md).
+
+    * **shed_storm** — a stats window where admission control rejected
+      the majority of offered traffic: the tier is past capacity and
+      the deadline budget is being defended at the door.  When the
+      watchdog ALSO tripped serve_queue_stall in the same stream, the
+      storm is named as the primary cause — the backlog is past its
+      deadline budget *because* offered load exceeds capacity, so the
+      fix is fleet size / offered QPS, not the queue.
+    * **canary_stuck** — a run whose LAST ``rollout`` row is ``begin``
+      or ``canary`` (the open-rollout heartbeat): the rollout never
+      resolved to commit/abort — the process died or wedged
+      mid-canary, and a fraction of traffic is still pinned to an
+      uncommitted artifact."""
+    out = []
+    storms = [
+        r for r in rows
+        if r.get("kind") == "serve_shed"
+        and float(r.get("shed_frac", 0.0)) >= SHED_STORM_FRAC
+        and int(r.get("shed_total", 0)) >= SHED_STORM_MIN_TOTAL
+    ]
+    if storms:
+        r = storms[-1]
+        causes = ", ".join(
+            f"{k}={v}" for k, v in sorted(
+                (r.get("by_cause") or {}).items()
+            )
+        ) or "?"
+        msg = (
+            f"shed storm in {len(storms)} stats window(s): admission "
+            f"control rejected {100 * float(r['shed_frac']):.0f}% of "
+            f"offered traffic ({r.get('shed_total')} sheds vs "
+            f"{r.get('admitted')} admitted; {causes}) — the tier is "
+            "past capacity and defended the deadline budget at the "
+            "door; add replicas or lower offered QPS (docs/SERVING.md)"
+        )
+        if queue_stall_tripped:
+            msg += (
+                "; the serve_queue_stall trip(s) above are this same "
+                "capacity condition, not an independent queue bug"
+            )
+        out.append(Diagnosis("warn", "shed_storm", msg))
+    for run in split_runs(rows):
+        rrows = [r for r in run.rows if r.get("kind") == "rollout"]
+        if rrows and rrows[-1].get("event") in ("begin", "canary"):
+            r = rrows[-1]
+            out.append(Diagnosis(
+                "warn",
+                "canary_stuck",
+                f"canary-stuck rollout: the stream's last rollout row "
+                f"is {r.get('event')!r} ({r.get('from_digest')} → "
+                f"{r.get('to_digest')}, canary_frac "
+                f"{r.get('canary_frac')}, {r.get('canary_requests')} "
+                f"canary request(s), {r.get('canary_errors')} "
+                "error(s)) with no commit/abort after it — the run "
+                "ended mid-rollout; commit, abort, or redeploy so "
+                "traffic converges on one artifact",
+            ))
+    return out
+
+
 def _check_flight(flight: dict) -> list[Diagnosis]:
     reason = flight.get("reason", "?")
     phase = flight.get("active_phase", "")
@@ -389,6 +463,12 @@ def diagnose(
     """Every check, ranked most-severe-first (stable within rank)."""
     findings: list[Diagnosis] = []
     findings.extend(_check_health(rows))
+    findings.extend(_check_serve(
+        rows,
+        queue_stall_tripped=any(
+            d.code == "serve_queue_stall" for d in findings
+        ),
+    ))
     if flight is not None:
         findings.extend(_check_flight(flight))
     findings.extend(_check_phases(rows))

@@ -106,6 +106,17 @@ class FlightRecorder:
         executed) one batch."""
         self._note("serve", detail, channel="serve")
 
+    def note_http(self, detail: str = "accept") -> None:
+        """HTTP front-end heartbeat: the accept loop completed one
+        ``serve_forever`` poll (serve/server.py ``service_actions``).
+        A separate channel from ``serve`` on purpose: the accept loop
+        beats unconditionally while alive, so folding it into the
+        serve channel would mask a wedged scoring path behind a
+        healthy front door — the watchdog classifies ``http`` silence
+        as serve_accept_stall and ``serve`` silence-with-backlog as
+        serve_queue_stall, independently."""
+        self._note("http", detail, channel="http")
+
     def note_store(self, detail: str = "note") -> None:
         """Tiered-store heartbeat: the promotion worker scored a batch
         of touch counts (store/promote.py).  Not watchdog-classified —

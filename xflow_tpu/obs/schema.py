@@ -138,7 +138,8 @@ SCHEMA: dict[str, dict[str, Any]] = {
     # one per MicroBatcher flush/close: per-request latency percentiles
     # (queue = enqueue→dequeue, featurize = request→Batch assembly,
     # device = h2d + execute + fetch) over the window since the last
-    # emission, plus coalescing effectiveness (requests/batches)
+    # emission, plus coalescing effectiveness (requests/batches) and
+    # the admission-control sheds booked against this window
     "serve_stats": {
         "t": (int, float),
         "kind": str,
@@ -171,6 +172,38 @@ SCHEMA: dict[str, dict[str, Any]] = {
         "device_p50": (int, float),
         "device_p99": (int, float),
         "compiles": int,
+    },
+    # one per fleet stats window (serve/fleet.py): admission-control
+    # accounting — requests admitted vs shed (per cause) plus the live
+    # backlog at emission.  A window whose shed_frac dominates is a
+    # shed storm: admission control protected the deadline budget by
+    # rejecting at the door (`obs doctor` blames capacity, not the
+    # queue).
+    "serve_shed": {
+        "t": (int, float),
+        "kind": str,
+        "admitted": int,
+        "shed_total": int,
+        "shed_frac": (int, float),
+        "by_cause": dict,
+        "errors": int,
+        "depth": int,
+        "queue_age_s": (int, float),
+    },
+    # one per staged-rollout transition (serve/fleet.py): event is
+    # begin / canary (open-rollout heartbeat, flushed with each stats
+    # window) / commit / abort.  A stream whose LAST rollout row is
+    # begin/canary died mid-rollout — `obs doctor` flags canary-stuck.
+    "rollout": {
+        "t": (int, float),
+        "kind": str,
+        "event": str,
+        "from_digest": str,
+        "to_digest": str,
+        "canary_frac": (int, float),
+        "canary_requests": int,
+        "canary_errors": int,
+        "detail": str,
     },
     # -- diagnosis (obs/watchdog.py, obs/flight.py; docs/OBSERVABILITY.md
     # "Diagnosing a sick run") ---------------------------------------------
@@ -216,6 +249,31 @@ OPTIONAL: dict[str, dict[str, Any]] = {
         "transfer_ahead_depth_mean": (int, float),
         # loaders that report parse phase bytes only
         "parse_mb_per_sec": (int, float),
+    },
+    # fleet-mode rows only (serve/fleet.py pools N replicas into one
+    # registry; rows written before the production tier predate these
+    # fields, so requiring them would fail old streams)
+    "serve_stats": {
+        "per_bucket": dict,
+        "shed_total": int,
+    },
+    # scored-and-returned count alongside admitted (completions lag
+    # admissions by the in-flight window; rows from before the counter
+    # predate the field)
+    "serve_shed": {
+        "completed": int,
+    },
+    # loadgen rows only (serve/loadgen.py open-loop SLO accounting;
+    # the closed-loop `bench` CLI predates these fields)
+    "serve_bench": {
+        "offered_qps": (int, float),
+        "offered_qps_actual": (int, float),
+        "achieved_qps": (int, float),
+        "shed_frac": (int, float),
+        "shed_by_cause": dict,
+        "errors": int,
+        "outstanding": int,
+        "per_bucket": dict,
     },
 }
 

@@ -21,6 +21,13 @@ Classification (separate thresholds, Config ``obs_watchdog_*``):
 * ``serve_queue_stall`` — the serve channel is silent past ``serve_s``
   WHILE work is pending (``set_pending`` callable); an idle batcher
   never trips.
+* ``serve_accept_stall`` — the ``http`` channel (the front end's
+  accept loop beats it unconditionally every ``serve_forever`` poll,
+  serve/server.py) is silent past ``serve_s`` while its pending probe
+  (``tier.running``) says the server should be alive.  Separate from
+  ``serve_queue_stall`` on purpose: a wedged accept loop with a
+  healthy scoring path and a wedged scoring path behind a healthy
+  front door are different pages.
 
 Escalation per incident: trip → log line + ``health`` JSONL row +
 instant trace event; silence reaching ``ESCALATE_FACTOR`` × threshold →
@@ -142,7 +149,7 @@ class Watchdog:
         if now is None:
             now = time.perf_counter()
         rows = []
-        for channel in ("train", "serve"):
+        for channel in ("train", "serve", "http"):
             row = self._check_channel(channel, now)
             if row is not None:
                 rows.append(row)
@@ -164,6 +171,8 @@ class Watchdog:
             pending = self._pending.get(channel)
         if pending is not None and not pending():
             return None  # idle, not stalled
+        if channel == "http":
+            return "serve_accept_stall", self.thresholds["serve"]
         return "serve_queue_stall", self.thresholds["serve"]
 
     def _check_channel(self, channel: str, now: float) -> dict | None:

@@ -21,12 +21,32 @@ Here that split is three layers:
   queue/featurize/device latency histograms (obs registry; JSONL kinds
   in obs/schema.py).
 
-CLI: ``python -m xflow_tpu.serve bench|score`` (docs/SERVING.md).
+The production tier stacks three more layers on those (docs/SERVING.md
+"Production tier"):
+
+* ``fleet`` — ``ReplicaFleet``: N engine replicas (clones sharing one
+  artifact's weights and AOT executables) behind round-robin routing,
+  admission control / typed load shedding (:class:`ShedError`), and
+  digest-guarded staged rollout (canary traffic split → health gate →
+  atomic fleet-wide swap);
+* ``server`` — ``ServeTier``: dependency-free concurrent HTTP front
+  end (stdlib ``ThreadingHTTPServer``) with JSON + packed-binary score
+  endpoints, typed 429 backpressure, rollout endpoints, and graceful
+  drain through the fleet's close() path;
+* ``loadgen`` — ``run_loadgen``: open-loop zipf traffic with SLO
+  accounting (``serve_bench`` rows gated by
+  scripts/check_serve_slo.py).
+
+CLI: ``python -m xflow_tpu.serve serve|loadgen|bench|score``
+(docs/SERVING.md).
 """
 
 from xflow_tpu.serve.artifact import export_artifact, load_manifest
 from xflow_tpu.serve.batcher import MicroBatcher
 from xflow_tpu.serve.engine import DEFAULT_BUCKETS, PredictEngine
+from xflow_tpu.serve.fleet import AdmissionPolicy, ReplicaFleet, ShedError
+from xflow_tpu.serve.loadgen import run_loadgen
+from xflow_tpu.serve.server import ServeTier
 
 __all__ = [
     "export_artifact",
@@ -34,4 +54,9 @@ __all__ = [
     "PredictEngine",
     "MicroBatcher",
     "DEFAULT_BUCKETS",
+    "ReplicaFleet",
+    "AdmissionPolicy",
+    "ShedError",
+    "ServeTier",
+    "run_loadgen",
 ]

@@ -1,8 +1,8 @@
-"""CLI: ``python -m xflow_tpu.serve <bench|score> ARTIFACT ...``
+"""CLI: ``python -m xflow_tpu.serve <serve|loadgen|bench|score> ...``
 
-    score  ARTIFACT --input FILE      pctr per libffm line (stdout/--out)
-    bench  ARTIFACT [--requests N]    concurrent single-row load through
-                                      the MicroBatcher; prints a JSON
+    score   ARTIFACT --input FILE     pctr per libffm line (stdout/--out)
+    bench   ARTIFACT [--requests N]   closed-loop concurrent load through
+                                      one MicroBatcher; prints a JSON
                                       summary with queue/featurize/
                                       device/e2e p50+p99 and logs
                                       serve_load/serve_stats/serve_bench
@@ -10,8 +10,27 @@
                                       ``python -m xflow_tpu.obs
                                       validate`` checks like any other
                                       metrics file
+    serve   ARTIFACT --port P         production tier: HTTP front end
+                                      (serve/server.py) over a replica
+                                      fleet (--replicas) with admission
+                                      control and staged rollout
+                                      (--canary-frac default); prints
+                                      one JSON line with the bound
+                                      address, then serves until
+                                      SIGTERM/SIGINT — which drain
+                                      gracefully through the tier/fleet
+                                      close() path (every accepted
+                                      request scores, final stats rows
+                                      flush)
+    loadgen ARTIFACT --qps Q          open-loop zipf traffic generator
+                                      (serve/loadgen.py) against an
+                                      in-process fleet or --url of a
+                                      running tier; logs the serve_bench
+                                      SLO row scripts/check_serve_slo.py
+                                      gates on
 
-Serving docs: docs/SERVING.md.
+Serving docs: docs/SERVING.md (the "Production tier" section covers
+serve/loadgen, rollout states, and the shed policy).
 """
 
 from __future__ import annotations
@@ -71,7 +90,6 @@ def cmd_bench(args) -> int:
     from xflow_tpu.obs.schema import validate_rows
     from xflow_tpu.serve.batcher import MicroBatcher
     from xflow_tpu.serve.engine import PredictEngine
-    from xflow_tpu.utils.logging import MetricsLogger
 
     engine = PredictEngine.load(
         args.artifact,
@@ -80,18 +98,10 @@ def cmd_bench(args) -> int:
         warm=True,
     )
     cfg = engine.cfg
-    logger = None
-    if args.metrics_out:
-        logger = MetricsLogger(
-            args.metrics_out,
-            run_header={
-                "run_id": f"{int(time.time() * 1000):x}-bench",
-                "config_digest": engine.digest,
-                "rank": 0,
-                "num_hosts": 1,
-                "model": cfg.model,
-            },
-        )
+    logger = _serve_logger(
+        args.metrics_out, engine.digest, cfg.model, "bench"
+    )
+    if logger is not None:
         logger.log("serve_load", {
             "artifact": args.artifact,
             "config_digest": engine.digest,
@@ -172,6 +182,151 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def _serve_logger(path: str, digest: str, model: str, tag: str):
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    if not path:
+        return None
+    return MetricsLogger(path, run_header={
+        "run_id": f"{int(time.time() * 1000):x}-{tag}",
+        "config_digest": digest,
+        "rank": 0,
+        "num_hosts": 1,
+        "model": model,
+    })
+
+
+def cmd_serve(args) -> int:
+    """The production tier: fleet + HTTP front end + watchdog, alive
+    until SIGTERM/SIGINT, then a graceful drain through
+    ``ServeTier.close()`` → ``ReplicaFleet.close()`` (every accepted
+    request scores; the final serve_stats/serve_shed rows flush)."""
+    import signal
+
+    from xflow_tpu.obs.flight import FlightRecorder
+    from xflow_tpu.obs.watchdog import Watchdog
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.server import ServeTier
+
+    flight = FlightRecorder()
+    fleet = ReplicaFleet.load(
+        args.artifact,
+        replicas=args.replicas,
+        num_devices=args.num_devices,
+        buckets=_buckets(args.buckets),
+        max_wait_ms=args.max_wait_ms,
+        deadline_budget_ms=args.deadline_budget_ms,
+        depth_budget=args.depth_budget,
+        flight=flight,
+    )
+    logger = _serve_logger(
+        args.metrics_out, fleet.digest, fleet.cfg.model, "serve"
+    )
+    fleet.metrics_logger = logger
+    flight.metrics_logger = logger
+    fleet.log_load(args.artifact)
+    tier = ServeTier(
+        fleet,
+        host=args.host,
+        port=args.port,
+        flight=flight,
+        default_canary_frac=args.canary_frac,
+    )
+    wd = Watchdog(
+        flight, serve_s=args.watchdog_serve_s, metrics_logger=logger
+    )
+    wd.set_pending("serve", fleet.pending)
+    wd.set_pending("http", lambda: tier.running)
+
+    stop = threading.Event()
+
+    def _drain(signum, frame) -> None:
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    tier.start()
+    wd.start()
+    print(json.dumps({
+        "serving": tier.address,
+        "digest": fleet.digest,
+        "model": fleet.cfg.model,
+        "replicas": fleet.replicas,
+        "buckets": list(fleet.engines[0].buckets),
+        "admission": fleet.policy.describe(),
+    }, sort_keys=True), flush=True)
+    # stats-window loop IS the main thread's job until a drain signal
+    while not stop.wait(args.stats_every_s):
+        fleet.emit_stats()
+    wd.stop()
+    final = tier.close()
+    if logger is not None:
+        logger.close()
+    print(json.dumps({"drained": final}, sort_keys=True), flush=True)
+    return 0
+
+
+def cmd_loadgen(args) -> int:
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.serve.loadgen import HttpTarget, run_loadgen
+
+    if args.url:
+        # remote mode: the artifact supplies only the key space
+        from xflow_tpu.config import Config
+        from xflow_tpu.serve.artifact import load_manifest
+
+        manifest = load_manifest(args.artifact)
+        digest = manifest["config_digest"]
+        model = manifest["model"]
+        table_size = int(Config.from_json(manifest["config"]).table_size)
+        target: object = HttpTarget(args.url)
+        fleet = None
+    else:
+        from xflow_tpu.serve.fleet import ReplicaFleet
+
+        fleet = ReplicaFleet.load(
+            args.artifact,
+            replicas=args.replicas,
+            num_devices=args.num_devices,
+            buckets=_buckets(args.buckets),
+            max_wait_ms=args.max_wait_ms,
+            deadline_budget_ms=args.deadline_budget_ms,
+            depth_budget=args.depth_budget,
+        )
+        digest, model = fleet.digest, fleet.cfg.model
+        table_size = None
+        target = fleet
+    logger = _serve_logger(args.metrics_out, digest, model, "loadgen")
+    if fleet is not None:
+        fleet.metrics_logger = logger
+        fleet.log_load(args.artifact)
+    try:
+        summary = run_loadgen(
+            target,
+            offered_qps=args.qps,
+            duration_s=args.duration_s,
+            concurrency=args.concurrency,
+            nnz=args.nnz,
+            zipf_a=args.zipf_a,
+            table_size=table_size,
+            seed=args.seed,
+            metrics_logger=logger,
+        )
+    finally:
+        if fleet is not None:
+            fleet.close()
+        if logger is not None:
+            logger.close()
+    if args.metrics_out:
+        errors = validate_rows(load_jsonl(args.metrics_out))
+        if errors:
+            for e in errors:
+                print(f"schema violation: {e}", file=sys.stderr)
+            return 1
+    print(json.dumps(summary, sort_keys=True))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m xflow_tpu.serve",
@@ -201,10 +356,68 @@ def main(argv: list[str] | None = None) -> int:
     pb.add_argument("--nnz", type=int, default=16, help="features/request")
     pb.add_argument("--seed", type=int, default=0)
     pb.add_argument("--metrics-out", default="")
+
+    def fleet_args(sp):
+        sp.add_argument(
+            "--replicas", type=int, default=2,
+            help="PredictEngine replicas behind the router (clones of "
+            "one loaded artifact — shared weights + compiles)",
+        )
+        sp.add_argument("--max-wait-ms", type=float, default=2.0)
+        sp.add_argument(
+            "--deadline-budget-ms", type=float, default=50.0,
+            help="admission control: shed when the oldest queued "
+            "request is older than this",
+        )
+        sp.add_argument(
+            "--depth-budget", type=int, default=256,
+            help="admission control: shed when a replica backlog "
+            "reaches this depth",
+        )
+        sp.add_argument("--metrics-out", default="")
+
+    pv = sub.add_parser(
+        "serve", help="HTTP serving tier (fleet + admission + rollout)"
+    )
+    common(pv)
+    fleet_args(pv)
+    pv.add_argument("--host", default="127.0.0.1")
+    pv.add_argument("--port", type=int, default=8000)
+    pv.add_argument(
+        "--canary-frac", type=float, default=0.1,
+        help="default canary traffic fraction for POST /v1/rollout",
+    )
+    pv.add_argument(
+        "--stats-every-s", type=float, default=10.0,
+        help="serve_stats/serve_shed window flush period",
+    )
+    pv.add_argument("--watchdog-serve-s", type=float, default=10.0)
+
+    pl = sub.add_parser(
+        "loadgen", help="open-loop zipf load generator (SLO rows)"
+    )
+    common(pl)
+    fleet_args(pl)
+    pl.add_argument(
+        "--url", default="",
+        help="target a RUNNING tier instead of an in-process fleet "
+        "(the artifact then only supplies the key space)",
+    )
+    pl.add_argument("--qps", type=float, default=500.0)
+    pl.add_argument("--duration-s", type=float, default=10.0)
+    pl.add_argument("--concurrency", type=int, default=8)
+    pl.add_argument("--nnz", type=int, default=8)
+    pl.add_argument("--zipf-a", type=float, default=1.3)
+    pl.add_argument("--seed", type=int, default=0)
+
     args = p.parse_args(argv)
 
     if args.cmd == "score":
         return cmd_score(args)
+    if args.cmd == "serve":
+        return cmd_serve(args)
+    if args.cmd == "loadgen":
+        return cmd_loadgen(args)
     return cmd_bench(args)
 
 

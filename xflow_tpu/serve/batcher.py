@@ -29,14 +29,43 @@ import queue
 import threading
 import time
 import warnings
+from collections import deque
 from concurrent.futures import Future
 from typing import Any
 
 import numpy as np
 
-from xflow_tpu.obs.registry import MetricsRegistry
+from xflow_tpu.obs.registry import MetricsRegistry, Snapshot
 
 _STOP = object()
+
+
+def stats_row_from_snapshot(snap: Snapshot) -> dict:
+    """Build a ``serve_stats`` record body from one registry snapshot.
+
+    Shared by ``MicroBatcher.emit_stats`` (one batcher, its own
+    registry) and ``serve/fleet.py`` (N batchers pooling ONE registry —
+    the fleet snapshots once and owns the row, so per-replica resets
+    never tear the window)."""
+
+    def pct(name: str, p: str) -> float:
+        return round(snap.hists.get(name, {}).get(p, 0.0), 6)
+
+    return {
+        "requests": int(snap.counters.get("serve.requests", 0)),
+        "batches": int(snap.counters.get("serve.batches", 0)),
+        "swaps": int(snap.counters.get("serve.swaps", 0)),
+        "shed_total": int(snap.counters.get("serve.shed_total", 0)),
+        "batch_fill_mean": round(
+            snap.hists.get("serve.batch_size", {}).get("mean", 0.0), 3
+        ),
+        "queue_p50": pct("serve.queue_seconds", "p50"),
+        "queue_p99": pct("serve.queue_seconds", "p99"),
+        "featurize_p50": pct("serve.featurize_seconds", "p50"),
+        "featurize_p99": pct("serve.featurize_seconds", "p99"),
+        "device_p50": pct("serve.device_seconds", "p50"),
+        "device_p99": pct("serve.device_seconds", "p99"),
+    }
 
 
 class MicroBatcher:
@@ -48,6 +77,7 @@ class MicroBatcher:
         registry: MetricsRegistry | None = None,
         metrics_logger=None,
         flight=None,
+        emit_on_close: bool = True,
     ):
         if max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
@@ -68,7 +98,17 @@ class MicroBatcher:
         self._max_batch = min(self._max_batch, engine.buckets[-1])
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics_logger = metrics_logger
+        # False when the registry is pooled across replicas
+        # (serve/fleet.py): the fleet snapshots ONCE and owns the final
+        # serve_stats row — a per-batcher emit on close would reset the
+        # shared window out from under the other replicas
+        self._emit_on_close = emit_on_close
         self._q: queue.Queue = queue.Queue()
+        # FIFO of enqueue stamps mirroring _q (admission-control feed):
+        # submit appends under _submit_lock, the worker pops one per
+        # dequeued request — depth()/queue_age_s() read the backlog
+        # without touching the queue internals
+        self._enq: deque[float] = deque()
         self._swap_lock = threading.Lock()
         self._submit_lock = threading.Lock()
         self._closed = False
@@ -103,7 +143,9 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
             fut: Future = Future()
-            self._q.put(((keys, slots, vals), fut, time.perf_counter()))
+            t = time.perf_counter()
+            self._enq.append(t)
+            self._q.put(((keys, slots, vals), fut, t))
         return fut
 
     def score(self, keys, slots=None, vals=None) -> float:
@@ -118,6 +160,36 @@ class MicroBatcher:
         with self._submit_lock:
             busy = self._busy
         return busy or not self._q.empty()
+
+    def depth(self) -> int:
+        """Requests accepted but not yet picked up by the worker — the
+        admission-control backlog gauge (serve/fleet.py sheds on it).
+        Excludes the batch currently in flight; ``pending()`` covers
+        that.  Lock-safe: read under the same lock ``submit`` appends
+        and the worker pops under."""
+        with self._submit_lock:
+            return len(self._enq)
+
+    def queue_age_s(self, now: float | None = None) -> float:
+        """Seconds the OLDEST still-queued request has waited (0.0 when
+        the backlog is empty).  The admission-control deadline gauge: a
+        new request admitted now queues behind this one, so its age is
+        a floor on the newcomer's queue time."""
+        if now is None:
+            now = time.perf_counter()
+        with self._submit_lock:
+            if not self._enq:
+                return 0.0
+            return now - self._enq[0]
+
+    def note_shed(self, cause: str) -> None:
+        """Book one admission-control rejection against this batcher's
+        registry — the shed request never enters the queue, so the
+        worker never sees it; the stats row carries the total (the
+        per-CAUSE split lives in the fleet's ``serve_shed`` row, the
+        one source of by-cause truth)."""
+        del cause  # part of the call contract; fleet books the split
+        self.registry.counter_add("serve.shed_total")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -143,24 +215,7 @@ class MicroBatcher:
         record (logged to the metrics JSONL when a logger is attached);
         returns the record."""
         snap = self.registry.snapshot(reset=True)
-
-        def pct(name: str, p: str) -> float:
-            return round(snap.hists.get(name, {}).get(p, 0.0), 6)
-
-        row = {
-            "requests": int(snap.counters.get("serve.requests", 0)),
-            "batches": int(snap.counters.get("serve.batches", 0)),
-            "swaps": int(snap.counters.get("serve.swaps", 0)),
-            "batch_fill_mean": round(
-                snap.hists.get("serve.batch_size", {}).get("mean", 0.0), 3
-            ),
-            "queue_p50": pct("serve.queue_seconds", "p50"),
-            "queue_p99": pct("serve.queue_seconds", "p99"),
-            "featurize_p50": pct("serve.featurize_seconds", "p50"),
-            "featurize_p99": pct("serve.featurize_seconds", "p99"),
-            "device_p50": pct("serve.device_seconds", "p50"),
-            "device_p99": pct("serve.device_seconds", "p99"),
-        }
+        row = stats_row_from_snapshot(snap)
         if self.metrics_logger is not None:
             self.metrics_logger.log("serve_stats", row)
         return row
@@ -205,7 +260,9 @@ class MicroBatcher:
                             threshold_seconds=join_timeout,
                             detail="worker outlived close() join",
                         ))
-                self._final_stats = self.emit_stats()
+                self._final_stats = (
+                    self.emit_stats() if self._emit_on_close else {}
+                )
             finally:
                 # set even on failure: a raising first closer must not
                 # leave concurrent closers blocked forever (they fail
@@ -232,9 +289,13 @@ class MicroBatcher:
                 return
             # busy from the FIRST dequeue: a request riding the
             # coalescing wait below is in flight even though the queue
-            # may be empty — pending() must not read it as idle
+            # may be empty — pending() must not read it as idle.  Each
+            # dequeued request also retires its enqueue stamp so
+            # depth()/queue_age_s() track only the waiting backlog.
             with self._submit_lock:
                 self._busy = True
+                if self._enq:
+                    self._enq.popleft()
             try:
                 reqs = [item]
                 deadline = time.perf_counter() + self._max_wait
@@ -253,6 +314,9 @@ class MicroBatcher:
                     if nxt is _STOP:
                         stopping = True
                         break
+                    with self._submit_lock:
+                        if self._enq:
+                            self._enq.popleft()
                     reqs.append(nxt)
                 self._run_batch(reqs)
             finally:
@@ -283,9 +347,15 @@ class MicroBatcher:
         # observes the full value — that is its latency, not an
         # amortized share.
         feat, dev = t1 - t0, t2 - t1
-        for i, (_, fut, _) in enumerate(reqs):
+        # featurize padded onto ONE bucket, so the prepared batch's row
+        # count IS the bucket that served these requests — the
+        # per-bucket e2e histograms (queue+featurize+device) feed the
+        # load generator's p50/p99-per-bucket report (serve/loadgen.py)
+        bucket = getattr(batch, "batch_size", len(reqs))
+        for i, (_, fut, t_enq) in enumerate(reqs):
             reg.observe("serve.featurize_seconds", feat)
             reg.observe("serve.device_seconds", dev)
+            reg.observe(f"serve.e2e.b{bucket}", t2 - t_enq)
             fut.set_result(float(pctr[i]))
         reg.counter_add("serve.requests", len(reqs))
         reg.counter_add("serve.batches", 1.0)

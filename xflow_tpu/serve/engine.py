@@ -138,13 +138,20 @@ class PredictEngine:
         )
         self.state = self._strip_state(state)
         # AOT executables keyed by (batch_rows, cold_nnz, hot_nnz) —
-        # canonical traffic only ever sees len(buckets) keys.
+        # canonical traffic only ever sees len(buckets) keys.  The dict
+        # may be SHARED across ``clone()`` replicas: executables are
+        # immutable once built, so ``compile_count`` is derived from it
+        # and counts compiles fleet-wide, exactly what the
+        # no-recompile-under-any-traffic guarantee wants to watch.
         self._compiled: dict[tuple[int, int, int], Any] = {}
-        self.compile_count = 0
         self.warm_seconds = 0.0
         self._parse_fn = None
         if warm:
             self.warm()
+
+    @property
+    def compile_count(self) -> int:
+        return len(self._compiled)
 
     # -- construction ------------------------------------------------------
 
@@ -223,6 +230,35 @@ class PredictEngine:
             digest=digest,
             warm=warm,
         )
+
+    def clone(self) -> "PredictEngine":
+        """A replica view over the SAME weights and the SAME compiled
+        executables — how serve/fleet.py fans one loaded artifact out
+        to N replicas without paying N× the XLA compiles or N× the
+        table HBM.
+
+        What is shared: ``state`` (device arrays — immutable on the
+        predict path), ``_compiled`` (AOT executables are immutable
+        once built; a rare concurrent non-canonical-shape miss at worst
+        compiles twice and last-write-wins), mesh, remap, digest.  What
+        is NOT shared: the ``TrainStep`` wire machinery — ``put_batch``
+        keeps per-instance host staging, and each fleet replica is
+        driven by its own MicroBatcher worker thread, so sharing the
+        step would race."""
+        replica = PredictEngine(
+            self.cfg,
+            self.state,
+            remap=self.remap,
+            mesh=self.mesh,
+            buckets=self.buckets,
+            obs=self.obs,
+            digest=self.digest,
+            warm=False,
+        )
+        replica.state = self.state  # share, don't re-strip-copy
+        replica._compiled = self._compiled
+        replica.warm_seconds = self.warm_seconds
+        return replica
 
     @staticmethod
     def _strip_state(state: dict[str, Any]) -> dict[str, Any]:
@@ -442,7 +478,6 @@ class PredictEngine:
                     .compile()
                 )
             self._compiled[key] = exe
-            self.compile_count += 1
             self.obs.counter("serve.compiles")
         with self.obs.phase("serve_execute"):
             garr = exe(self.state, arrays)

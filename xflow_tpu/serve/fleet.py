@@ -1,0 +1,705 @@
+"""Replica fleet — N PredictEngine replicas behind one admission-
+controlled router, with digest-guarded staged rollout.
+
+The in-process serving stack (engine + MicroBatcher) serves one
+replica.  Production traffic wants three more disciplines, modeled on
+the replica/rollout/SLO structure of Google's ads scoring
+infrastructure (PAPERS.md, arXiv:2501.10546) and the model-freshness
+hot-swap hooks the online-advertising framework paper treats as table
+stakes (arXiv:2201.05500):
+
+* **Replication.**  ``ReplicaFleet.load`` loads ONE artifact and fans
+  it out to N replicas via ``PredictEngine.clone()`` — shared weights
+  and shared AOT executables (one compile set fleet-wide), but a
+  private MicroBatcher + TrainStep per replica so each replica's
+  worker thread owns its host staging.  Requests route round-robin;
+  every replica batcher pools ONE registry, so ``serve_stats`` rows
+  are fleet-wide windows.
+
+* **Admission control / load shedding.**  Before a request enqueues,
+  the chosen replica's backlog is checked against the micro-batch
+  deadline budget: queue DEPTH over ``depth_budget`` or queue AGE over
+  ``deadline_budget_ms`` sheds the request with a typed
+  :class:`ShedError` (cause ``queue_depth`` / ``queue_age``), counted
+  per cause and reported in ``serve_shed`` JSONL rows.  Shedding at
+  the door keeps the p99 of ADMITTED requests inside the deadline
+  budget instead of letting the queue eat the SLO for everyone.
+
+* **Staged rollout.**  ``begin_rollout(artifact)`` loads the candidate
+  (digest-guarded: a different config digest is a redeploy, refused
+  unless ``force``), swaps it into ONE canary replica, and routes
+  ``canary_frac`` of traffic there.  The canary's completions/errors/
+  latency accumulate under the fleet lock; ``commit_rollout`` refuses
+  until the health gate passes (``min_canary_requests`` served,
+  error fraction ≤ ``max_error_frac``) and then swaps every remaining
+  replica atomically (each batcher swap is atomic per coalesced batch,
+  so no batch ever mixes two artifacts).  ``abort_rollout`` swaps the
+  canary back.  Every transition logs a ``rollout`` JSONL row;
+  ``obs doctor`` flags a rollout that begins and never resolves
+  (canary-stuck).
+
+Thread model (XF006–XF009 clean by construction): the fleet owns NO
+threads — replica MicroBatcher workers and the HTTP handler threads
+(serve/server.py) drive it.  All mutable fleet state (router counter,
+rollout state, shed/error counters) lives under ``self._lock``; the
+lock is never held across a blocking call, a batcher submit, or an
+engine swap's digest check... with one deliberate exception: commit/
+abort swap replicas under the fleet lock so a concurrent ``submit``
+can never route to a half-swapped fleet (lock order fleet._lock →
+MicroBatcher._swap_lock, acyclic — batcher code never takes the fleet
+lock).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Sequence
+
+from xflow_tpu.obs.registry import Histogram, MetricsRegistry
+from xflow_tpu.serve.batcher import MicroBatcher, stats_row_from_snapshot
+
+
+class ShedError(RuntimeError):
+    """Typed backpressure: the request was REJECTED by admission
+    control, not failed — the caller should retry after backoff (the
+    HTTP front end maps this to 429 with the cause in the body)."""
+
+    def __init__(self, cause: str, depth: int, queue_age_s: float,
+                 budget: str):
+        super().__init__(
+            f"request shed: {cause} (depth {depth}, oldest queued "
+            f"{queue_age_s * 1e3:.1f}ms, budget {budget})"
+        )
+        self.cause = cause
+        self.depth = depth
+        self.queue_age_s = queue_age_s
+
+
+class RolloutError(RuntimeError):
+    """A rollout transition was refused (no rollout open, one already
+    open, or the canary health gate has not passed)."""
+
+
+class AdmissionPolicy:
+    """Shed decision for ONE replica backlog against the micro-batch
+    deadline budget.  ``deadline_budget_ms`` bounds the oldest queued
+    request's age (a newcomer queues behind it, so its age floors the
+    newcomer's wait); ``depth_budget`` bounds raw backlog depth."""
+
+    def __init__(self, deadline_budget_ms: float = 50.0,
+                 depth_budget: int = 256):
+        if deadline_budget_ms <= 0 or depth_budget < 1:
+            raise ValueError(
+                "deadline_budget_ms must be > 0 and depth_budget >= 1"
+            )
+        self.deadline_budget_s = deadline_budget_ms / 1000.0
+        self.depth_budget = depth_budget
+
+    def check(self, batcher: MicroBatcher) -> str | None:
+        """Shed cause for admitting one more request to ``batcher``
+        right now, or None to admit."""
+        if batcher.depth() >= self.depth_budget:
+            return "queue_depth"
+        if batcher.queue_age_s() > self.deadline_budget_s:
+            return "queue_age"
+        return None
+
+    def describe(self) -> str:
+        return (
+            f"age<={self.deadline_budget_s * 1e3:.0f}ms,"
+            f"depth<{self.depth_budget}"
+        )
+
+
+class ReplicaFleet:
+    def __init__(
+        self,
+        engine,
+        replicas: int = 2,
+        *,
+        max_wait_ms: float = 2.0,
+        max_batch: int | None = None,
+        deadline_budget_ms: float = 50.0,
+        depth_budget: int = 256,
+        metrics_logger=None,
+        flight=None,
+        registry: MetricsRegistry | None = None,
+    ):
+        if replicas < 1:
+            raise ValueError("a fleet needs at least 1 replica")
+        self.policy = AdmissionPolicy(deadline_budget_ms, depth_budget)
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.metrics_logger = metrics_logger
+        self.flight = flight
+        self.engines = [engine] + [
+            engine.clone() for _ in range(replicas - 1)
+        ]
+        self.batchers = [
+            MicroBatcher(
+                e,
+                max_wait_ms=max_wait_ms,
+                max_batch=max_batch,
+                registry=self.registry,
+                metrics_logger=None,  # the fleet owns the stats rows
+                flight=flight,
+                emit_on_close=False,
+            )
+            for e in self.engines
+        ]
+        self._lock = threading.Lock()
+        self._seq = 0  # request sequence (idle round-robin)
+        # non-canary round-robin under an open rollout — its OWN
+        # counter: _seq stays phase-locked with the canary stripe (at
+        # canary_frac=0.5 every non-canary _seq is odd), so indexing
+        # others[] by _seq would starve some replicas entirely
+        self._rr = 0
+        self._admitted = 0
+        self._completed = 0
+        self._errors = 0
+        self._shed: dict[str, int] = {}
+        self._rollout: dict[str, Any] | None = None
+        # serializes rollout-row emission (terminal rows vs the stats
+        # window's canary heartbeat) WITHOUT holding the fleet lock
+        # across logger I/O — see emit_stats
+        self._ro_log_lock = threading.Lock()
+        self._closed = False
+        self._drained = threading.Event()
+        self._final_rows: dict = {}
+        self._load_kw: dict[str, Any] = {}
+        self.digest = engine.digest
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def load(
+        cls,
+        artifact: str,
+        replicas: int = 2,
+        *,
+        num_devices: int = 1,
+        buckets: Sequence[int] | None = None,
+        obs=None,
+        warm: bool = True,
+        **kw,
+    ) -> "ReplicaFleet":
+        """Load one artifact from the shared store and fan it out to
+        ``replicas`` clones (one compile set, shared weights)."""
+        from xflow_tpu.serve.engine import PredictEngine
+
+        engine = PredictEngine.load(
+            artifact,
+            num_devices=num_devices,
+            buckets=buckets,
+            obs=obs,
+            warm=warm,
+        )
+        fleet = cls(engine, replicas, **kw)
+        # rollouts load candidates the same way this fleet was loaded
+        fleet._load_kw = {
+            "num_devices": num_devices,
+            "buckets": buckets,
+            "obs": obs,
+        }
+        fleet.log_load(artifact)
+        return fleet
+
+    def log_load(self, artifact: str) -> None:
+        """One ``serve_load`` row for the artifact this fleet serves.
+        ``load`` calls it; the CLI calls it AGAIN after attaching a
+        metrics logger (the logger's run header needs the loaded
+        digest, so it cannot exist before ``load`` returns)."""
+        if self.metrics_logger is None:
+            return
+        e = self.engines[0]
+        self.metrics_logger.log("serve_load", {
+            "artifact": artifact,
+            "config_digest": e.digest,
+            "model": e.cfg.model,
+            "buckets": list(e.buckets),
+            "warm_seconds": round(e.warm_seconds, 6),
+            "compiles": e.compile_count,
+        })
+
+    @property
+    def cfg(self):
+        return self.engines[0].cfg
+
+    @property
+    def replicas(self) -> int:
+        return len(self.batchers)
+
+    # -- request side -------------------------------------------------------
+
+    def _route(self) -> tuple[int, dict | None]:
+        """(replica index, rollout token) for the next request — the
+        token is the open rollout dict when this request is canary
+        traffic, else None (``_done`` compares it by IDENTITY, so a
+        straggler from an aborted rollout can never pollute the next
+        rollout's health gate).  Under an open rollout,
+        ``canary_frac`` of the sequence goes to the canary replica —
+        error-accumulator striping (Bresenham), so canary requests
+        INTERLEAVE with fleet traffic at any fraction (a modulo split
+        would aim a contiguous burst of full offered QPS at the one
+        canary replica and shed it into a spurious gate failure) —
+        and the rest round-robins the others; idle fleets round-robin
+        everything."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ReplicaFleet is closed")
+            self._seq += 1
+            ro = self._rollout
+            if ro is not None:
+                ro["acc"] += ro["canary_frac"]
+                if ro["acc"] >= 1.0:
+                    ro["acc"] -= 1.0
+                    return ro["canary"], ro
+                others = [
+                    i for i in range(len(self.batchers))
+                    if i != ro["canary"]
+                ]
+                if not others:  # single-replica fleet: all canary
+                    return ro["canary"], ro
+                self._rr += 1
+                return others[self._rr % len(others)], None
+            return self._seq % len(self.batchers), None
+
+    def submit(self, keys, slots=None, vals=None) -> Future:
+        """Admission-checked enqueue onto one replica; returns the
+        pctr Future.  Raises :class:`ShedError` when the replica's
+        backlog breaches the deadline budget — the typed backpressure
+        signal, never silently queued past the SLO."""
+        idx, ro_token = self._route()
+        batcher = self.batchers[idx]
+        cause = self.policy.check(batcher)
+        if cause is not None:
+            batcher.note_shed(cause)
+            with self._lock:
+                self._shed[cause] = self._shed.get(cause, 0) + 1
+            raise ShedError(
+                cause,
+                batcher.depth(),
+                batcher.queue_age_s(),
+                self.policy.describe(),
+            )
+        t0 = time.perf_counter()
+        fut = batcher.submit(keys, slots, vals)
+        with self._lock:
+            self._admitted += 1
+        fut.add_done_callback(
+            lambda f, t0=t0, ro=ro_token: self._done(f, t0, ro)
+        )
+        return fut
+
+    def score(self, keys, slots=None, vals=None,
+              timeout: float | None = 60.0) -> float:
+        return float(self.submit(keys, slots, vals).result(timeout))
+
+    def _done(self, fut: Future, t0: float,
+              ro_token: dict | None) -> None:
+        """Completion bookkeeping (runs on the resolving replica's
+        worker thread — worker context, so everything under the fleet
+        lock).  Canary health only counts completions whose routing
+        token IS the still-open rollout: a straggler from a resolved
+        rollout must not feed the gate of the one that replaced it."""
+        err = fut.exception() is not None
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._completed += 1
+            if err:
+                self._errors += 1
+            ro = self._rollout
+            if ro_token is not None and ro is ro_token:
+                ro["requests"] += 1
+                if err:
+                    ro["errors"] += 1
+                else:
+                    # errors have their own gate (max_error_frac); a
+                    # fast-failing or timed-out request must not skew
+                    # the p99 gate's success-latency population
+                    ro["latency"].observe(dt)
+
+    def pending(self) -> bool:
+        """Any replica has queued or in-flight work — the watchdog's
+        serve-channel pending probe for the whole fleet."""
+        return any(b.pending() for b in self.batchers)
+
+    def depth(self) -> int:
+        return sum(b.depth() for b in self.batchers)
+
+    def queue_age_s(self) -> float:
+        return max(b.queue_age_s() for b in self.batchers)
+
+    # -- staged rollout -----------------------------------------------------
+
+    def _load_candidate(self, artifact):
+        if not isinstance(artifact, str):
+            return artifact  # pre-built engine (tests, live handoff)
+        from xflow_tpu.serve.engine import PredictEngine
+
+        # candidates must match the incumbent's serving geometry; a
+        # directly-constructed fleet (no load()) derives it from the
+        # engine it was built around instead of silently loading the
+        # defaults (1-device mesh, default buckets → recompiles and
+        # latency shifts with no error)
+        inc = self.engines[0]
+        kw = self._load_kw or {
+            "num_devices": int(inc.mesh.devices.size),
+            "buckets": list(inc.buckets),
+            "obs": inc.obs,
+        }
+        return PredictEngine.load(artifact, warm=True, **kw)
+
+    def _log_rollout(self, event: str, ro: dict, detail: str) -> None:
+        if self.metrics_logger is not None:
+            self.metrics_logger.log("rollout", {
+                "event": event,
+                "from_digest": ro["from_digest"],
+                "to_digest": ro["to_digest"],
+                "canary_frac": ro["canary_frac"],
+                "canary_requests": ro["requests"],
+                "canary_errors": ro["errors"],
+                "detail": detail,
+            })
+
+    def begin_rollout(
+        self,
+        artifact,
+        canary_frac: float = 0.1,
+        *,
+        min_canary_requests: int = 32,
+        max_error_frac: float = 0.0,
+        max_p99_ms: float | None = None,
+        auto_commit: bool = False,
+        force: bool = False,
+    ) -> dict:
+        """Load the candidate artifact (or take a pre-built engine),
+        swap it into one canary replica, and start routing
+        ``canary_frac`` of traffic there.  Digest-guarded: a candidate
+        whose config digest differs from the serving digest is refused
+        unless ``force`` (that is a redeploy, not a rollout) — the
+        check runs BEFORE any traffic shifts.  Returns the rollout
+        state snapshot."""
+        if not 0.0 < canary_frac <= 1.0:
+            raise ValueError("canary_frac must be in (0, 1]")
+        # cheap refusals BEFORE the candidate load: an already-open
+        # rollout must not cost a full artifact load + warm compile on
+        # the handler thread (the authoritative re-check still runs
+        # under the lock below)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ReplicaFleet is closed")
+            if self._rollout is not None:
+                raise RolloutError(
+                    "a rollout is already open (commit or abort it "
+                    "first)"
+                )
+        candidate = self._load_candidate(artifact)
+        if not force and candidate.digest != self.digest:
+            raise ValueError(
+                f"rollout refused: candidate digest {candidate.digest} "
+                f"!= serving digest {self.digest} (different config/"
+                "geometry is a redeploy — pass force=True only if you "
+                "mean it)"
+            )
+        # _ro_log_lock held across rollout creation AND the begin row:
+        # the rollout becomes routable the moment the fleet lock drops,
+        # and a fast auto-commit (accept-loop tick) takes _ro_log_lock
+        # for its terminal row — holding it here guarantees "begin" is
+        # the stream's first row for this rollout.  Order matches
+        # emit_stats: _ro_log_lock -> _lock.
+        with self._ro_log_lock:
+            ro = self._begin_rollout_locked(
+                candidate, canary_frac, min_canary_requests,
+                max_error_frac, max_p99_ms, auto_commit, force,
+            )
+            self._log_rollout(
+                "begin", ro, f"canary replica {ro['canary']}"
+            )
+        return self.rollout_state()
+
+    def _begin_rollout_locked(
+        self, candidate, canary_frac, min_canary_requests,
+        max_error_frac, max_p99_ms, auto_commit, force,
+    ) -> dict:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ReplicaFleet is closed")
+            if self._rollout is not None:
+                raise RolloutError(
+                    "a rollout is already open (commit or abort it "
+                    "first)"
+                )
+            canary = 0
+            old = self.batchers[canary].engine
+            self.batchers[canary].swap(candidate, force=force)
+            # keep engines[] mirroring what each batcher serves: stats
+            # reads compile_count through it, and a canary recompile
+            # storm must be visible DURING the canary phase
+            self.engines[canary] = candidate
+            self._rollout = {
+                "canary": canary,
+                "candidate": candidate,
+                "old": old,
+                "from_digest": old.digest,
+                "to_digest": candidate.digest,
+                "canary_frac": float(canary_frac),
+                "min_requests": int(min_canary_requests),
+                "max_error_frac": float(max_error_frac),
+                "max_p99_ms": max_p99_ms,
+                "auto_commit": bool(auto_commit),
+                # a forced begin (redeploy) implies forced swaps at
+                # commit: the remaining replicas still run the OLD
+                # digest, so the commit-side swap needs force too
+                "force": bool(force),
+                "acc": 0.0,  # canary striping accumulator (_route)
+                "requests": 0,
+                "errors": 0,
+                "latency": Histogram(capacity=4096),
+                "t0": time.perf_counter(),
+            }
+            return self._rollout
+
+    def rollout_state(self) -> dict | None:
+        """JSON-ready snapshot of the open rollout (None when idle):
+        counters, health verdict, and the gate it is waiting on."""
+        with self._lock:
+            ro = self._rollout
+            if ro is None:
+                return None
+            return dict(self._health_locked(ro), **{
+                "from_digest": ro["from_digest"],
+                "to_digest": ro["to_digest"],
+                "canary_frac": ro["canary_frac"],
+                "canary_replica": ro["canary"],
+                "auto_commit": ro["auto_commit"],
+                "age_seconds": round(
+                    time.perf_counter() - ro["t0"], 3
+                ),
+            })
+
+    def _health_locked(self, ro: dict) -> dict:
+        """Canary health under the already-held fleet lock."""
+        n, e = ro["requests"], ro["errors"]
+        error_frac = e / n if n else 0.0
+        p99_s = ro["latency"].percentile(99)
+        healthy = n >= ro["min_requests"] and error_frac <= ro[
+            "max_error_frac"
+        ]
+        if healthy and ro["max_p99_ms"] is not None:
+            healthy = p99_s * 1000.0 <= ro["max_p99_ms"]
+        return {
+            "canary_requests": n,
+            "canary_errors": e,
+            "error_frac": round(error_frac, 6),
+            "canary_p99_ms": round(p99_s * 1000.0, 3),
+            "healthy": healthy,
+            "gate": (
+                f"requests>={ro['min_requests']},"
+                f"error_frac<={ro['max_error_frac']}"
+                + (
+                    f",p99<={ro['max_p99_ms']}ms"
+                    if ro["max_p99_ms"] is not None
+                    else ""
+                )
+            ),
+        }
+
+    def commit_rollout(self, force: bool = False) -> dict:
+        """Atomic fleet-wide swap to the candidate — refused until the
+        canary health gate passes (``force`` overrides).  Every
+        remaining replica gets its own clone of the candidate (shared
+        weights + executables); each batcher swap is per-batch atomic,
+        so in-flight batches finish on the old engine and no batch
+        ever mixes artifacts."""
+        with self._lock:
+            ro = self._rollout
+            if ro is None:
+                raise RolloutError("no rollout open")
+            health = self._health_locked(ro)
+            if not force and not health["healthy"]:
+                raise RolloutError(
+                    f"commit refused: canary not healthy ({health}) — "
+                    "wait for the gate or abort_rollout()"
+                )
+            candidate = ro["candidate"]
+        # clone outside the lock (TrainStep construction is not free;
+        # submits must not stall behind it), then re-take it and verify
+        # the rollout is still THIS one before the atomic swap
+        clones = [
+            candidate.clone()
+            for i in range(len(self.batchers))
+            if i != ro["canary"]
+        ]
+        with self._lock:
+            if self._rollout is not ro:
+                raise RolloutError(
+                    "rollout changed during commit (concurrent "
+                    "commit/abort won)"
+                )
+            health = self._health_locked(ro)
+            it = iter(clones)
+            for i, b in enumerate(self.batchers):
+                if i == ro["canary"]:
+                    self.engines[i] = candidate
+                    continue
+                b.swap(next(it), force=force or ro["force"])
+                self.engines[i] = b.engine
+            self.digest = candidate.digest
+            self._rollout = None
+        with self._ro_log_lock:
+            self._log_rollout("commit", ro, f"health {health}")
+        return health
+
+    def abort_rollout(self, detail: str = "") -> dict:
+        """Swap the canary back to the old engine and close the
+        rollout; traffic re-converges on the incumbent artifact."""
+        with self._lock:
+            ro = self._rollout
+            if ro is None:
+                raise RolloutError("no rollout open")
+            health = self._health_locked(ro)
+            self.batchers[ro["canary"]].swap(ro["old"], force=True)
+            self.engines[ro["canary"]] = ro["old"]
+            self._rollout = None
+        with self._ro_log_lock:
+            self._log_rollout("abort", ro, detail or f"health {health}")
+        return health
+
+    def rollout_tick(self) -> str | None:
+        """Advance an auto rollout: commit once the health gate passes,
+        abort once the error gate is provably failed (enough canary
+        traffic, too many errors).  Called periodically from the HTTP
+        server's accept loop (serve/server.py ``service_actions``);
+        returns the transition taken, if any."""
+        with self._lock:
+            ro = self._rollout
+            if ro is None or not ro["auto_commit"]:
+                return None
+            health = self._health_locked(ro)
+            doomed = (
+                ro["requests"] >= ro["min_requests"]
+                and health["error_frac"] > ro["max_error_frac"]
+            )
+        try:
+            if health["healthy"]:
+                self.commit_rollout()
+                return "commit"
+            if doomed:
+                self.abort_rollout(detail="auto: error gate failed")
+                return "abort"
+        except RolloutError:
+            # a concurrent manual commit/abort won the race — the
+            # rollout resolved either way
+            pass
+        return None
+
+    # -- stats / lifecycle --------------------------------------------------
+
+    def _shed_row_locked(self) -> dict:
+        total = sum(self._shed.values())
+        denom = self._admitted + total
+        return {
+            "admitted": self._admitted,
+            "completed": self._completed,
+            "shed_total": total,
+            "shed_frac": round(total / denom, 6) if denom else 0.0,
+            "by_cause": dict(self._shed),
+            "errors": self._errors,
+        }
+
+    def emit_stats(self) -> dict:
+        """Flush one fleet-wide window: a ``serve_stats`` row (pooled
+        registry snapshot, with per-bucket e2e percentiles) and a
+        ``serve_shed`` row (admitted/shed per cause + live backlog).
+        Window counters reset; returns ``{"stats": ..., "shed": ...}``.
+        """
+        snap = self.registry.snapshot(reset=True)
+        row = stats_row_from_snapshot(snap)
+        per_bucket = {}
+        pre = "serve.e2e.b"
+        for name, h in sorted(snap.hists.items()):
+            if name.startswith(pre):
+                per_bucket[name[len(pre):]] = {
+                    "requests": int(h["count"]),
+                    "p50": round(h["p50"], 6),
+                    "p99": round(h["p99"], 6),
+                }
+        row["per_bucket"] = per_bucket
+        with self._lock:
+            shed = self._shed_row_locked()
+            self._admitted = 0
+            self._completed = 0
+            self._errors = 0
+            self._shed = {}
+            ro = self._rollout
+        shed["depth"] = self.depth()
+        shed["queue_age_s"] = round(self.queue_age_s(), 6)
+        if self.metrics_logger is not None:
+            self.metrics_logger.log("serve_stats", row)
+            self.metrics_logger.log("serve_shed", shed)
+        if ro is not None:
+            # open-rollout heartbeat row: a stream that ends on one of
+            # these (no commit/abort after) is what `obs doctor` flags
+            # as canary-stuck.  Ordering discipline WITHOUT logger I/O
+            # under the fleet lock: the still-open check runs under
+            # the fleet lock, the log itself only under _ro_log_lock.
+            # commit/abort clear _rollout (fleet lock) BEFORE taking
+            # _ro_log_lock for their terminal row, so either we see
+            # the rollout resolved and skip, or we hold _ro_log_lock
+            # first and the terminal row lands after our heartbeat —
+            # a stale "canary" can never be the stream's last word.
+            with self._ro_log_lock:
+                with self._lock:
+                    still_open = self._rollout is ro
+                if still_open:
+                    self._log_rollout("canary", ro, "rollout open")
+        return {"stats": row, "shed": shed}
+
+    def stats(self) -> dict:
+        """Non-destructive live view (the /v1/stats endpoint): pooled
+        registry snapshot WITHOUT reset + admission counters + rollout
+        state."""
+        snap = self.registry.snapshot(reset=False)
+        with self._lock:
+            shed = self._shed_row_locked()
+        return {
+            "digest": self.digest,
+            "replicas": self.replicas,
+            "stats": stats_row_from_snapshot(snap),
+            "shed": shed,
+            "depth": self.depth(),
+            "queue_age_s": round(self.queue_age_s(), 6),
+            "rollout": self.rollout_state(),
+            "compiles": self.engines[0].compile_count,
+        }
+
+    def close(self) -> dict:
+        """Drain every replica (accepted requests all score), then
+        flush the final fleet window.  Idempotent; a rollout still
+        open at close stays UNRESOLVED in the stream — shutting down
+        mid-canary IS the canary-stuck condition doctor should see."""
+        with self._lock:
+            first = not self._closed
+            self._closed = True
+        if first:
+            try:
+                for b in self.batchers:
+                    b.close()
+                final = self.emit_stats()
+                with self._lock:
+                    self._final_rows = final
+            finally:
+                # set even on failure so concurrent closers never hang
+                self._drained.set()
+        else:
+            self._drained.wait()
+        with self._lock:
+            return self._final_rows
+
+    def __enter__(self) -> "ReplicaFleet":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

@@ -1,0 +1,405 @@
+"""Zipf-traffic load generator — offered-QPS open loop with SLO
+accounting (`serve_bench` rows for scripts/check_serve_slo.py).
+
+Closed-loop benchmarking (the ``bench`` CLI) measures latency at
+whatever rate the system happens to sustain — it can never show load
+shedding, because the clients slow down with the server.  Production
+SLOs are stated the other way: *offered* traffic arrives on its own
+clock and the tier either serves it inside the deadline or sheds it.
+This generator models that:
+
+* **Open loop.**  Arrivals are scheduled on a fixed global timeline
+  (request *i* at ``i / offered_qps`` seconds); ``concurrency`` worker
+  threads stripe the timeline and never wait for responses — each
+  submit attaches a completion callback and moves to its next arrival.
+  A slow tier therefore builds real queue depth and real sheds,
+  exactly what admission control is for.
+* **Zipf keys.**  Request keys are zipf(a)-ranked ids spread over the
+  table by an odd multiplier (a bijection mod the power-of-two table
+  size, so frequencies are preserved but hot keys aren't clustered) —
+  the ads-traffic skew the whole input stack is built around.
+* **SLO accounting.**  The summary carries offered vs achieved QPS,
+  shed fraction per cause, error count, client-observed e2e p50/p99,
+  and the fleet's per-bucket latency percentiles — everything
+  ``check_serve_slo.py`` gates on, flushed as one ``serve_bench`` JSONL
+  row (plus the fleet's ``serve_stats``/``serve_shed`` rows).
+
+Targets: a :class:`~xflow_tpu.serve.fleet.ReplicaFleet` directly
+(in-process — the SLO gate's mode, and the only TRULY open-loop one:
+``submit`` returns a Future immediately) or a running HTTP tier via
+:class:`HttpTarget`.  **HTTP-mode caveat:** each worker scores
+synchronously over its connection (429 → shed), so the offered rate
+caps at ``concurrency / e2e_latency`` — size ``--concurrency`` at
+least ``offered_qps × expected_e2e_s`` or the run degrades toward
+closed-loop; the summary's ``offered_qps`` (requested) vs
+``offered_qps_actual`` (what the timeline actually achieved) exposes
+the gap, and ``check_serve_slo.py`` gates against the actual.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any
+
+import numpy as np
+
+from xflow_tpu.obs.registry import Histogram
+from xflow_tpu.serve.fleet import ShedError
+
+# spread multiplier: odd → bijective mod any power-of-two table size
+_SPREAD = 0x9E3779B1
+
+
+def zipf_rows(
+    rng: np.random.Generator,
+    n: int,
+    *,
+    table_size: int,
+    nnz: int,
+    zipf_a: float = 1.3,
+    max_fields: int = 10,
+) -> list[tuple]:
+    """``n`` featurize_raw-protocol rows of zipf-skewed keys."""
+    ranks = rng.zipf(zipf_a, size=(n, nnz)).astype(np.uint64)
+    keys = ((ranks * _SPREAD) % table_size).astype(np.int64)
+    slots = (np.arange(nnz, dtype=np.int32) % max(max_fields, 1))
+    return [(keys[i], slots.copy(), None) for i in range(n)]
+
+
+class HttpTarget:
+    """Adapter giving an HTTP serving tier the fleet ``submit``
+    protocol: synchronous single-row POST per call (the worker thread
+    IS the connection), resolved-Future return, 429 → ShedError.
+
+    Each worker thread keeps ONE persistent HTTP/1.1 connection
+    (thread-local, reconnect-once on a server-closed keep-alive
+    socket): a per-request TCP handshake would inflate the client
+    e2e percentiles that ``check_serve_slo.py`` gates on with a cost
+    the tier never incurred."""
+
+    def __init__(self, url: str, timeout_s: float = 30.0):
+        from urllib.parse import urlsplit
+
+        self.url = url.rstrip("/")
+        parts = urlsplit(self.url)
+        if parts.scheme not in ("http", ""):
+            raise ValueError(
+                f"HttpTarget speaks plain http, got {parts.scheme!r}"
+            )
+        self._host = parts.hostname or "127.0.0.1"
+        self._port = parts.port or 80
+        self._path = parts.path.rstrip("/")
+        self.timeout_s = timeout_s
+        self._local = threading.local()
+
+    def _post(self, path: str, body: bytes) -> tuple[int, bytes]:
+        import http.client
+
+        conn = getattr(self._local, "conn", None)
+        reused = conn is not None
+        for attempt in (0, 1):
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=self.timeout_s
+                )
+                self._local.conn = conn
+            try:
+                conn.request(
+                    "POST", self._path + path, body=body,
+                    headers={"Content-Type": "application/octet-stream"},
+                )
+                r = conn.getresponse()
+                return r.status, r.read()
+            except ConnectionError:
+                # the server may close an idle keep-alive socket
+                # between arrivals (RemoteDisconnected subclasses
+                # ConnectionResetError) — retry ONCE on a fresh
+                # connection, and only when THIS socket had served
+                # before.  Anything else (timeout after the request
+                # was delivered, failure on a fresh connection) must
+                # NOT be re-sent: the tier may have admitted the
+                # request, and a duplicate both double-scores it and
+                # double-loads a tier that is already struggling — it
+                # surfaces as ONE failed request instead.
+                conn.close()
+                self._local.conn = conn = None
+                if attempt or not reused:
+                    raise
+            except Exception:
+                conn.close()
+                self._local.conn = conn = None
+                raise
+        raise AssertionError("unreachable")
+
+    def submit(self, keys, slots=None, vals=None) -> Future:
+        import json
+
+        from xflow_tpu.serve.server import (
+            decode_packed_response,
+            encode_packed_request,
+        )
+
+        fut: Future = Future()
+        try:
+            status, payload = self._post(
+                "/v1/score_packed",
+                encode_packed_request([(keys, slots, vals)]),
+            )
+        except Exception as e:  # connection errors → failed request
+            fut.set_exception(e)
+            return fut
+        if status == 429:
+            try:
+                doc = json.loads(payload.decode() or "{}")
+            except ValueError:
+                doc = {}  # a proxy's bare 429 is still a shed
+            raise ShedError(
+                doc.get("cause", "unknown"),
+                int(doc.get("depth", 0)),
+                float(doc.get("queue_age_ms", 0.0)) / 1000.0,
+                "remote",
+            )
+        if status != 200:
+            fut.set_exception(RuntimeError(
+                f"HTTP {status}: {payload[:200]!r}"
+            ))
+            return fut
+        try:
+            fut.set_result(float(decode_packed_response(payload)[0]))
+        except Exception as e:
+            fut.set_exception(e)
+        return fut
+
+
+class _Recorder:
+    """Thread-safe completion sink (callbacks run on replica worker
+    threads; workers read nothing until the drain barrier)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._lat = Histogram(capacity=65536)
+        self.submitted = 0
+        self.completed = 0
+        self.errors = 0
+        self.shed: dict[str, int] = {}
+        self._shed_total = 0
+
+    def note_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def note_shed(self, cause: str) -> None:
+        with self._lock:
+            self.shed[cause] = self.shed.get(cause, 0) + 1
+            self._shed_total += 1
+
+    def note_error(self) -> None:
+        """A request that failed AT submit (no Future ever existed) —
+        books a completed-with-error so ``outstanding`` stays exact."""
+        with self._lock:
+            self.completed += 1
+            self.errors += 1
+
+    def note_done(self, fut: Future, t0: float) -> None:
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.completed += 1
+            if fut.exception() is not None:
+                self.errors += 1
+            else:
+                self._lat.observe(dt)
+
+    def outstanding(self) -> int:
+        """Offered requests still awaiting resolution.  Sheds resolved
+        AT the door (no Future ever existed), so they must not count —
+        the drain barrier would otherwise spin its full timeout on
+        every run with a single shed."""
+        with self._lock:
+            return self.submitted - self.completed - self._shed_total
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "errors": self.errors,
+                "shed": dict(self.shed),
+                "e2e_p50": round(self._lat.percentile(50), 6),
+                "e2e_p99": round(self._lat.percentile(99), 6),
+            }
+
+
+def run_loadgen(
+    target,
+    *,
+    offered_qps: float,
+    duration_s: float,
+    concurrency: int = 8,
+    nnz: int = 8,
+    zipf_a: float = 1.3,
+    table_size: int | None = None,
+    seed: int = 0,
+    drain_timeout_s: float = 30.0,
+    metrics_logger=None,
+) -> dict:
+    """Drive ``target`` (a ReplicaFleet or HttpTarget) with open-loop
+    zipf traffic; returns (and optionally logs as ``serve_bench``) the
+    SLO summary.  When the target is a fleet, its stats window is
+    flushed into the summary (queue/featurize/device + per-bucket
+    percentiles + shed rows)."""
+    if offered_qps <= 0 or duration_s <= 0 or concurrency < 1:
+        raise ValueError("offered_qps/duration_s/concurrency must be > 0")
+    if zipf_a <= 1.0:
+        raise ValueError("zipf_a must be > 1 (numpy zipf domain)")
+    if table_size is None:
+        cfg = getattr(target, "cfg", None)
+        if cfg is None:
+            # HttpTarget has no engine config to read the key space
+            # from — a remote tier's table size isn't knowable here
+            raise ValueError(
+                "table_size is required for targets without a .cfg "
+                "(e.g. HttpTarget): pass table_size=2**cfg_log2 "
+                "matching the serving artifact"
+            )
+        table_size = int(cfg.table_size)
+    count = max(1, int(offered_qps * duration_s))
+    rec = _Recorder()
+    # the open-loop clock starts AFTER every stripe has pre-generated
+    # its rows (barrier action runs in the last arriving thread): a
+    # start stamped before generation would put large runs behind
+    # schedule from arrival 0 and turn the ramp into a burst that
+    # inflates the very numbers check_serve_slo gates on
+    start_cell = [0.0]
+
+    def _stamp_start() -> None:
+        start_cell[0] = time.perf_counter() + 0.05
+
+    gen_barrier = threading.Barrier(concurrency + 1, action=_stamp_start)
+
+    def worker(wid: int) -> None:
+        # pre-generate this worker's rows so the hot loop is
+        # sleep → submit, not RNG time
+        idxs = range(wid, count, concurrency)
+        rows = None
+        try:
+            rng = np.random.default_rng(seed + wid)
+            rows = zipf_rows(
+                rng, len(idxs),
+                table_size=table_size, nnz=nnz, zipf_a=zipf_a,
+            )
+        except Exception:
+            pass  # booked below, after the barrier
+        try:
+            gen_barrier.wait(timeout=60.0)
+        except threading.BrokenBarrierError:
+            rows = None  # no shared clock; this stripe cannot run
+        if rows is None:
+            # a stripe that cannot build its rows must not vanish: book
+            # every one of its arrivals as a failed request, or the
+            # summary reports a clean gate-passing run over traffic
+            # that was never sent
+            for _ in idxs:
+                rec.note_submit()
+                rec.note_error()
+            return
+        start = start_cell[0]
+        for j, i in enumerate(idxs):
+            delay = (start + i / offered_qps) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            rec.note_submit()
+            t0 = time.perf_counter()
+            try:
+                fut = target.submit(*rows[j])
+            except ShedError as e:
+                rec.note_shed(e.cause)
+                continue
+            except Exception:
+                # a submit-side failure is ONE failed request, not a
+                # dead worker: the stripe must keep offering its
+                # 1/concurrency share or the summary reports a clean
+                # run over traffic that was never sent
+                rec.note_error()
+                continue
+            fut.add_done_callback(
+                lambda f, t0=t0: rec.note_done(f, t0)
+            )
+
+    threads = [
+        # daemon: the bounded join below already tolerates (and
+        # reports) leaked workers — a non-daemon stripe wedged in a
+        # socket timeout would hold interpreter shutdown hostage for
+        # its whole remaining arrival schedule
+        threading.Thread(
+            target=worker, args=(w,), name=f"xflow-loadgen-{w}",
+            daemon=True,
+        )
+        for w in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        gen_barrier.wait(timeout=60.0)
+    except threading.BrokenBarrierError:
+        # a stripe died before generating (hard failure): workers see
+        # the same break and book their arrivals as errors; fall back
+        # to "now" so the deadlines below still bound the run
+        start_cell[0] = time.perf_counter()
+    start = start_cell[0]
+    join_deadline = (
+        start + duration_s + drain_timeout_s
+    )
+    for t in threads:
+        t.join(timeout=max(0.1, join_deadline - time.perf_counter()))
+    leaked = sum(t.is_alive() for t in threads)
+    # open-loop drain: submissions stopped; wait (bounded) for the
+    # tier to resolve what it admitted
+    while rec.outstanding() > 0 and time.perf_counter() < join_deadline:
+        time.sleep(0.01)
+    seconds = time.perf_counter() - start
+    snap = rec.snapshot()
+    sheds = sum(snap["shed"].values())
+    denom = snap["submitted"]
+    summary: dict[str, Any] = {
+        # serve_bench required fields
+        "requests": snap["completed"] - snap["errors"],
+        "concurrency": concurrency,
+        "seconds": round(seconds, 6),
+        "requests_per_sec": round(
+            (snap["completed"] - snap["errors"]) / max(seconds, 1e-9), 1
+        ),
+        "e2e_p50": snap["e2e_p50"],
+        "e2e_p99": snap["e2e_p99"],
+        # SLO extras (schema-optional)
+        "offered_qps": round(offered_qps, 1),
+        "offered_qps_actual": round(denom / max(seconds, 1e-9), 1),
+        "achieved_qps": round(
+            (snap["completed"] - snap["errors"]) / max(seconds, 1e-9), 1
+        ),
+        "shed_frac": round(sheds / denom, 6) if denom else 0.0,
+        "shed_by_cause": snap["shed"],
+        "errors": snap["errors"] + leaked,
+        "outstanding": rec.outstanding(),
+    }
+    if hasattr(target, "emit_stats"):
+        rows = target.emit_stats()  # serve_stats + serve_shed flushed
+        stats = rows["stats"]
+        for f in (
+            "queue_p50", "queue_p99", "featurize_p50", "featurize_p99",
+            "device_p50", "device_p99",
+        ):
+            summary[f] = stats[f]
+        summary["per_bucket"] = stats.get("per_bucket", {})
+        summary["compiles"] = target.engines[0].compile_count
+    else:
+        for f in (
+            "queue_p50", "queue_p99", "featurize_p50", "featurize_p99",
+            "device_p50", "device_p99",
+        ):
+            summary[f] = 0.0
+        summary["compiles"] = 0
+    if metrics_logger is not None:
+        metrics_logger.log("serve_bench", summary)
+    return summary

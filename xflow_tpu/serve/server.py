@@ -1,0 +1,510 @@
+"""Concurrent HTTP front end over a ReplicaFleet — the RPC tier.
+
+Dependency-free (stdlib ``http.server.ThreadingHTTPServer``): every
+connection gets a handler thread, every scoring request routes through
+the fleet's admission control into a replica MicroBatcher, so the
+device still sees bucketed coalesced batches no matter how many
+concurrent sockets are open.
+
+Endpoints:
+
+* ``POST /v1/score`` — JSON ``{"rows": [{"keys": [...], "slots":
+  [...]?, "vals": [...]?}, ...]}`` → ``{"pctr": [...], "digest":
+  ...}``.  A single row may be passed as ``{"keys": [...]}``.
+* ``POST /v1/score_packed`` — the packed-binary wire (below), for
+  callers who care about encode cost; same scoring path.
+* ``GET /healthz`` — liveness + serving digest + rollout state.
+* ``GET /v1/stats`` — non-destructive fleet stats snapshot.
+* ``POST /v1/rollout`` — ``{"artifact": dir, "canary_frac": 0.1,
+  "auto_commit": false, ...}`` begins a staged rollout;
+  ``POST /v1/rollout/commit`` / ``/v1/rollout/abort`` resolve it.
+
+Backpressure is TYPED: an admission-control shed returns **429** with
+``{"error": "backpressure", "cause": "queue_depth"|"queue_age",
+"retry_after_ms": ...}`` and a ``Retry-After`` header — clients
+distinguish "slow down" from "broken" without string-matching.
+
+Packed wire (little-endian): request ``b"XFS1" u32 nrows`` then per
+row ``u16 nnz, nnz*u64 keys, nnz*u32 slots, nnz*f32 vals``; response
+``u32 n, n*f32 pctr``.  ``encode_packed_request`` /
+``decode_packed_response`` are the client halves (serve/loadgen.py
+uses them).
+
+Liveness: the accept loop beats the flight recorder's ``http`` channel
+from ``service_actions`` (called every poll of ``serve_forever``), so
+a watchdog classifies a wedged accept loop as ``serve_accept_stall``
+while the per-batch ``serve`` channel keeps covering the scoring path.
+The same hook drives ``fleet.rollout_tick()`` — auto rollouts advance
+even when no admin client is polling.
+
+Shutdown (XF006): ``close()`` stops the accept loop, joins the server
+thread with a timeout, waits briefly for in-flight handlers to drain
+through the fleet, then closes the fleet (which drains every replica
+queue — accepted requests all score) and flushes the final stats rows.
+``python -m xflow_tpu.serve serve`` routes SIGTERM here.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import numpy as np
+
+from concurrent.futures import TimeoutError as FutureTimeout
+
+from xflow_tpu.serve.fleet import ReplicaFleet, RolloutError, ShedError
+
+PACKED_MAGIC = b"XFS1"
+# how long a handler waits on its scoring futures before 504
+SCORE_TIMEOUT_S = 60.0
+
+
+# -- packed wire --------------------------------------------------------------
+
+
+def encode_packed_request(rows: list) -> bytes:
+    """Rows are ``(keys, slots, vals)`` tuples (slots/vals may be
+    None) or bare key arrays — the ``featurize_raw`` row protocol."""
+    out = [PACKED_MAGIC, struct.pack("<I", len(rows))]
+    for row in rows:
+        keys, slots, vals = row if isinstance(row, tuple) else (
+            row, None, None
+        )
+        k = np.asarray(keys, dtype=np.uint64)
+        n = len(k)
+        s = (
+            np.zeros(n, np.uint32) if slots is None
+            else np.asarray(slots, dtype=np.uint32)
+        )
+        v = (
+            np.ones(n, np.float32) if vals is None
+            else np.asarray(vals, dtype=np.float32)
+        )
+        if len(s) != n or len(v) != n:
+            raise ValueError("keys/slots/vals length mismatch")
+        out.append(struct.pack("<H", n))
+        out.append(k.astype("<u8").tobytes())
+        out.append(s.astype("<u4").tobytes())
+        out.append(v.astype("<f4").tobytes())
+    return b"".join(out)
+
+
+def decode_packed_request(buf: bytes) -> list[tuple]:
+    if buf[:4] != PACKED_MAGIC:
+        raise ValueError(
+            f"bad packed-request magic {buf[:4]!r} (want {PACKED_MAGIC!r})"
+        )
+    (nrows,) = struct.unpack_from("<I", buf, 4)
+    off = 8
+    rows: list[tuple] = []
+    for _ in range(nrows):
+        if off + 2 > len(buf):
+            raise ValueError("truncated packed request (row header)")
+        (nnz,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        need = nnz * (8 + 4 + 4)
+        if off + need > len(buf):
+            raise ValueError("truncated packed request (row payload)")
+        keys = np.frombuffer(buf, "<u8", nnz, off).astype(np.int64)
+        off += nnz * 8
+        slots = np.frombuffer(buf, "<u4", nnz, off).astype(np.int32)
+        off += nnz * 4
+        vals = np.frombuffer(buf, "<f4", nnz, off).astype(np.float32)
+        off += nnz * 4
+        rows.append((keys, slots, vals))
+    if off != len(buf):
+        raise ValueError(
+            f"packed request has {len(buf) - off} trailing byte(s)"
+        )
+    return rows
+
+
+def encode_packed_response(pctr: np.ndarray) -> bytes:
+    p = np.asarray(pctr, dtype=np.float32)
+    return struct.pack("<I", len(p)) + p.astype("<f4").tobytes()
+
+
+def decode_packed_response(buf: bytes) -> np.ndarray:
+    (n,) = struct.unpack_from("<I", buf, 0)
+    out = np.frombuffer(buf, "<f4", n, 4)
+    if len(out) != n:
+        raise ValueError("truncated packed response")
+    return np.array(out)
+
+
+# -- server -------------------------------------------------------------------
+
+
+class _TierServer(ThreadingHTTPServer):
+    # handler threads must not block process exit on a wedged socket.
+    # NOTE: stdlib _Threads.append SKIPS daemon threads, so
+    # server_close() joins nothing here — the drain contract ("every
+    # accepted request scores and gets its response written") is
+    # instead enforced by ServeTier's in-flight handler counter:
+    # close() waits (bounded) for _inflight to hit zero BEFORE closing
+    # the fleet, covering handlers still parsing a body (not yet
+    # submitted) and handlers still writing a response
+    daemon_threads = True
+    tier: "ServeTier"
+
+    def service_actions(self) -> None:
+        # accept-loop heartbeat (every serve_forever poll): the
+        # watchdog's `http` channel — silence here means the front
+        # door is wedged, regardless of how the scoring path feels
+        tier = self.tier
+        if tier.flight is not None:
+            tier.flight.note_http("accept")
+        # auto rollouts advance here so they progress with no admin
+        # client polling.  Known tradeoff: an auto-COMMIT clones the
+        # candidate per replica on this thread, pausing accepts (new
+        # connections queue in the listen backlog) for the clone time
+        # — once per rollout; fleets where that outlasts the watchdog
+        # http threshold should commit via POST /v1/rollout/commit
+        # (handler thread) instead of auto_commit.
+        try:
+            tier.fleet.rollout_tick()
+        except Exception as e:
+            # a failing transition (clone OOM, logger I/O) must not
+            # unwind serve_forever and turn a rollout problem into a
+            # total serving outage — the rollout stays open, so the
+            # canary-stuck doctor diagnosis surfaces it
+            import warnings
+
+            warnings.warn(
+                f"rollout_tick failed (rollout left open): {e!r}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "xflow-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing -----------------------------------------------------------
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        pass  # metrics rows, not stderr chatter
+
+    @property
+    def tier(self) -> "ServeTier":
+        return self.server.tier  # type: ignore[attr-defined]
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length) if length else b""
+
+    def _respond(self, code: int, payload: bytes, ctype: str,
+                 headers: dict[str, str] | None = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _json(self, code: int, doc: dict,
+              headers: dict[str, str] | None = None) -> None:
+        self._respond(
+            code,
+            json.dumps(doc, sort_keys=True).encode(),
+            "application/json",
+            headers,
+        )
+
+    def _shed(self, e: ShedError) -> None:
+        retry_ms = max(
+            1, int(self.tier.fleet.policy.deadline_budget_s * 1000)
+        )
+        self._json(429, {
+            "error": "backpressure",
+            "cause": e.cause,
+            "depth": e.depth,
+            "queue_age_ms": round(e.queue_age_s * 1000.0, 3),
+            "retry_after_ms": retry_ms,
+        }, headers={"Retry-After": str(max(1, retry_ms // 1000))})
+
+    # -- scoring ------------------------------------------------------------
+
+    def _score_rows(self, rows: list[tuple]) -> np.ndarray:
+        """All-or-nothing admission: the first shed fails the whole
+        request (already-admitted rows still score and resolve — the
+        batcher drains them — but the client is told to back off)."""
+        fleet = self.tier.fleet
+        futs = [fleet.submit(*row) for row in rows]
+        deadline = time.perf_counter() + SCORE_TIMEOUT_S
+        return np.asarray([
+            f.result(timeout=max(0.001, deadline - time.perf_counter()))
+            for f in futs
+        ], dtype=np.float32)
+
+    def _handle_score_json(self, body: bytes) -> None:
+        doc = json.loads(body.decode())
+        if not isinstance(doc, dict):
+            raise ValueError(
+                "request body must be a JSON object "
+                '({"rows": [...]} or one row {"keys": [...]})'
+            )
+        raw = doc["rows"] if "rows" in doc else [doc]
+        if not isinstance(raw, list):
+            raise ValueError('"rows" must be a list of row objects')
+        rows = []
+        for r in raw:
+            if not isinstance(r, dict):
+                raise ValueError('each row must be an object with "keys"')
+            try:
+                keys = np.asarray(r["keys"], dtype=np.int64)
+                slots = (
+                    np.asarray(r["slots"], dtype=np.int32)
+                    if r.get("slots") is not None else None
+                )
+                vals = (
+                    np.asarray(r["vals"], dtype=np.float32)
+                    if r.get("vals") is not None else None
+                )
+            except TypeError as e:
+                # np.asarray raises TypeError on ragged/object fields
+                # — a client problem, not a server fault (400 not 500)
+                raise ValueError(f"bad row field: {e}") from None
+            rows.append((keys, slots, vals))
+        pctr = self._score_rows(rows)
+        self._json(200, {
+            "pctr": [round(float(p), 6) for p in pctr],
+            "digest": self.tier.fleet.digest,
+        })
+
+    def _handle_score_packed(self, body: bytes) -> None:
+        rows = decode_packed_request(body)
+        pctr = self._score_rows(rows)
+        self._respond(
+            200, encode_packed_response(pctr), "application/octet-stream"
+        )
+
+    # -- HTTP verbs ---------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler protocol)
+        self.tier._handler_enter()
+        try:
+            self._do_get()
+        finally:
+            self.tier._handler_exit()
+
+    def _do_get(self) -> None:
+        try:
+            if self.path == "/healthz":
+                fleet = self.tier.fleet
+                self._json(200, {
+                    "status": "serving",
+                    "digest": fleet.digest,
+                    "model": fleet.cfg.model,
+                    "replicas": fleet.replicas,
+                    "depth": fleet.depth(),
+                    "rollout": fleet.rollout_state(),
+                })
+            elif self.path == "/v1/stats":
+                self._json(200, self.tier.fleet.stats())
+            else:
+                self._json(404, {"error": f"no such path {self.path}"})
+        except ConnectionError:
+            pass  # client went away mid-read/write; nothing to answer
+        except Exception as e:  # handler threads must answer, not die
+            try:
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
+            except ConnectionError:
+                pass  # the failure WAS the dead socket
+
+    def do_POST(self) -> None:  # noqa: N802
+        self.tier._handler_enter()
+        try:
+            self._do_post()
+        finally:
+            self.tier._handler_exit()
+
+    def _do_post(self) -> None:
+        try:
+            body = self._body()
+            if self.path == "/v1/score":
+                self._handle_score_json(body)
+            elif self.path == "/v1/score_packed":
+                self._handle_score_packed(body)
+            elif self.path == "/v1/rollout":
+                doc = json.loads(body.decode()) if body else {}
+                state = self.tier.fleet.begin_rollout(
+                    doc["artifact"],
+                    canary_frac=float(doc.get(
+                        "canary_frac", self.tier.default_canary_frac
+                    )),
+                    min_canary_requests=int(
+                        doc.get("min_canary_requests", 32)
+                    ),
+                    max_error_frac=float(doc.get("max_error_frac", 0.0)),
+                    max_p99_ms=doc.get("max_p99_ms"),
+                    auto_commit=bool(doc.get("auto_commit", False)),
+                    force=bool(doc.get("force", False)),
+                )
+                self._json(200, {"rollout": state})
+            elif self.path == "/v1/rollout/commit":
+                doc = json.loads(body.decode()) if body else {}
+                health = self.tier.fleet.commit_rollout(
+                    force=bool(doc.get("force", False))
+                )
+                self._json(200, {"committed": health})
+            elif self.path == "/v1/rollout/abort":
+                health = self.tier.fleet.abort_rollout(detail="api")
+                self._json(200, {"aborted": health})
+            else:
+                self._json(404, {"error": f"no such path {self.path}"})
+        except ShedError as e:
+            self._shed(e)
+        except RolloutError as e:
+            self._json(409, {"error": str(e)})
+        except (TimeoutError, FutureTimeout) as e:
+            # admitted but the scoring future outlived SCORE_TIMEOUT_S:
+            # a gateway-timeout condition, not a server bug
+            self._json(504, {"error": f"scoring timed out: {e}"})
+        except (ValueError, KeyError, json.JSONDecodeError,
+                struct.error) as e:
+            # struct.error: truncated/garbage packed wire is a client
+            # problem, same as unparseable JSON
+            self._json(400, {"error": f"{type(e).__name__}: {e}"})
+        except ConnectionError:
+            pass  # client went away mid-read/write; nothing to answer
+        except Exception as e:
+            try:
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
+            except ConnectionError:
+                pass  # the failure WAS the dead socket
+
+
+class ServeTier:
+    """The running server: fleet + accept loop + drain discipline."""
+
+    def __init__(
+        self,
+        fleet: ReplicaFleet,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        flight=None,
+        poll_s: float = 0.25,
+        drain_timeout_s: float = 30.0,
+        default_canary_frac: float = 0.1,
+    ):
+        self.fleet = fleet
+        self.flight = flight
+        self.default_canary_frac = default_canary_frac
+        self._poll_s = poll_s
+        self._drain_timeout_s = drain_timeout_s
+        self._httpd = _TierServer((host, port), _Handler)
+        self._httpd.tier = self
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._closed = False
+        self._final_rows: dict = {}
+        # live handler-thread count (daemon handlers are NOT joined by
+        # server_close — see _TierServer); close() drains on this
+        self._inflight = 0
+
+    def _handler_enter(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def _handler_exit(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    def inflight(self) -> int:
+        """Handler threads currently between accept and response-
+        written — the drain barrier's second condition (a handler may
+        hold an accepted request it has not yet submitted, which
+        ``fleet.pending()`` cannot see)."""
+        with self._lock:
+            return self._inflight
+
+    @property
+    def running(self) -> bool:
+        """The accept loop should be beating: started and not closed —
+        the watchdog's pending probe for the ``http`` channel
+        (``wd.set_pending("http", lambda: tier.running)``): silence
+        while True is a serve_accept_stall, silence after close() is
+        just a stopped server."""
+        with self._lock:
+            return self._thread is not None and not self._closed
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ServeTier":
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ServeTier is closed")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._serve,
+                    name="xflow-serve-accept",
+                    daemon=True,
+                )
+                self._thread.start()
+        return self
+
+    def _serve(self) -> None:
+        self._httpd.serve_forever(poll_interval=self._poll_s)
+
+    def close(self) -> dict:
+        """Graceful drain: stop accepting, join the accept loop, wait
+        for in-flight handlers to push their work into the replica
+        queues, then close the fleet (drains every accepted request)
+        and return the final stats rows.  Idempotent."""
+        with self._lock:
+            first = not self._closed
+            self._closed = True
+            thread = self._thread
+            self._thread = None
+        if not first:
+            return self._final_rows
+        if thread is not None:
+            # shutdown() blocks on serve_forever's is-shut-down event;
+            # on a never-started tier that event never sets, so only
+            # a live accept loop gets the shutdown handshake
+            self._httpd.shutdown()
+            thread.join(timeout=10.0)
+            if thread.is_alive():  # pragma: no cover - wedged socket
+                import warnings
+
+                warnings.warn(
+                    "serve accept loop outlived shutdown join",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        self._httpd.server_close()
+        # drain window: every live handler finishes (parse → submit →
+        # result → response WRITTEN) and every replica queue empties;
+        # only then may the fleet close — an accepted request must
+        # never see "ReplicaFleet is closed"
+        deadline = time.perf_counter() + self._drain_timeout_s
+        while (
+            (self.inflight() > 0 or self.fleet.pending())
+            and time.perf_counter() < deadline
+        ):
+            time.sleep(0.01)
+        final = self.fleet.close()
+        with self._lock:
+            self._final_rows = final
+        return final
+
+    def __enter__(self) -> "ServeTier":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
