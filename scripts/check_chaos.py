@@ -1,0 +1,356 @@
+"""Chaos gate (tier-1): the self-healing fabric under a SEEDED fault
+schedule must be indistinguishable — in model outputs — from the
+fault-free run, with every injected fault accounted for
+(docs/ROBUSTNESS.md).
+
+Part 1 — train → checkpoint → kill → auto-resume → export:
+
+* **ref**: lr, 2 epochs, no chaos → artifact → P_ref.
+* **run A**: checkpointing on, ``ckpt.finalize:nth=2`` armed — the
+  epoch-0 generation commits (hit 1), the epoch-1 save is KILLED
+  mid-commit (hit 2: manifest written, rename never runs).  The run
+  dies on the injected fault (``checkpoint_save_failed`` health row,
+  flight dump, crash-path close); only a ``.tmp-ckpt-*`` is left and
+  the epoch-0 generation stays the newest complete one.
+* **corruption**: a manifest-less ``ckpt-9999999999`` dir simulates an
+  externally truncated generation (the one failure the commit protocol
+  itself can never produce).
+* **run B**: a fresh trainer, ``restore(auto=True)`` — skips the
+  corrupt generation (``checkpoint_fallback`` health row), restores
+  the epoch-0 generation, retrains epoch 1 with
+  ``loader.read_block:nth=1`` armed (transient read, healed by the
+  bounded retry — ``recovered:io_retry`` health row), exports.
+* **gate**: P_chaos within 1e-6 of P_ref; every registry fire has a
+  matching ``chaos`` JSONL row; every armed site has its healing
+  ``health`` row; zero leaked threads.
+
+Part 2 — loadgen-driven fleet under scoring faults:
+
+* a 2-replica fleet scores a fixed probe set fault-free → S_ref;
+* a second fleet (``evict_after_errors=1``) runs open-loop zipf load
+  with ``serve.replica_score:p=1,times=2`` armed: the poisoned batches
+  error, the owning replicas are EVICTED from routing
+  (``replica_evicted``), background revives re-clone them from the
+  shared artifact (``replica_revived``);
+* **gate**: the fleet returns to full health, the probe set scores
+  within 1e-6 of S_ref, evictions == revivals, chaos rows match
+  registry fires, zero leaked threads.
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python scripts/check_chaos.py
+
+Wired into tier-1 via tests/test_chaos.py::test_check_chaos_script.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PARITY_ATOL = 1e-6
+# thread-name prefixes this repo's fabrics own — none may survive
+_THREAD_PREFIXES = (
+    "store-promote", "xflow-serve", "xflow-replica-revive",
+    "xflow-loadgen", "xflow-obs-watchdog",
+)
+
+
+def _leaked_threads() -> list[str]:
+    return sorted(
+        t.name for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith(_THREAD_PREFIXES)
+    )
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from tests.gen_data import generate_dataset
+    from xflow_tpu import chaos
+    from xflow_tpu.config import Config
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.loadgen import run_loadgen
+    from xflow_tpu.trainer import Trainer
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    errors: list[str] = []
+    expected_fires: dict[str, int] = {}
+
+    with tempfile.TemporaryDirectory() as root:
+        ds = generate_dataset(
+            os.path.join(root, "data"),
+            num_train_shards=2,
+            lines_per_shard=200,
+            num_fields=10,
+            vocab_per_field=8,
+            seed=11,
+            scale=3.0,
+        )
+        base = dict(
+            train_path=ds.train_prefix,
+            test_path=ds.test_prefix,
+            model="lr",
+            epochs=2,
+            batch_size=64,
+            table_size_log2=16,
+            max_nnz=24,
+            num_devices=1,
+            parse_workers=1,  # deterministic failpoint hit order
+        )
+        rng = np.random.default_rng(0)
+        probes = [
+            rng.integers(0, 1 << 16, size=int(rng.integers(1, 12)))
+            for _ in range(64)
+        ]
+
+        # -- part 1: train / checkpoint / kill / auto-resume ---------------
+        chaos.disarm()
+        ref = Trainer(Config(**base))
+        ref.train()
+        art_ref = export_artifact(ref, os.path.join(root, "art_ref"))
+        ref.close()
+        eng_ref = PredictEngine.load(art_ref, buckets=(64,), warm=False)
+        p_ref = eng_ref.predict(eng_ref.featurize_raw(probes))
+
+        ck = os.path.join(root, "ck")
+        metrics = os.path.join(root, "train.jsonl")
+        cfg_a = Config(
+            checkpoint_dir=ck,
+            metrics_out=metrics,
+            chaos_spec="seed=3;ckpt.finalize:nth=2",
+            **base,
+        )
+        trainer_a = Trainer(cfg_a)
+        reg_a = chaos.armed()  # close() disarms config-armed schedules
+        died = None
+        try:
+            trainer_a.train()
+        except chaos.ChaosError as e:
+            died = e
+        finally:
+            trainer_a.close()
+        if died is None:
+            errors.append(
+                "run A survived the ckpt.finalize kill — the failpoint "
+                "never fired or the save swallowed it"
+            )
+        for site, n in reg_a.fired().items():
+            expected_fires[site] = expected_fires.get(site, 0) + n
+        from xflow_tpu.utils.checkpoint import latest_complete
+
+        gens = [d for d in os.listdir(ck) if d.startswith("ckpt-")]
+        if len(gens) != 1:
+            errors.append(
+                f"expected exactly the epoch-0 generation after the "
+                f"kill mid-commit (the epoch-1 save must never have "
+                f"become visible), found {sorted(gens)}"
+            )
+        gen_a = latest_complete(ck)
+        if gen_a is None:
+            errors.append(
+                "latest_complete found nothing after the kill — the "
+                "epoch-0 generation should have survived"
+            )
+
+        # externally truncated generation: a committed-looking dir with
+        # no manifest (the commit protocol can never produce this)
+        os.makedirs(os.path.join(ck, "ckpt-9999999999"))
+
+        cfg_b = cfg_a.replace(
+            chaos_spec="seed=3;loader.read_block:nth=1"
+        )
+        trainer_b = Trainer(cfg_b)
+        reg_b = chaos.armed()
+        cursor = trainer_b.restore(auto=True)
+        if cursor is None or int(cursor.get("epoch", -1)) != 1:
+            errors.append(
+                f"--resume auto restored cursor {cursor}, expected the "
+                "complete epoch-0 generation (epoch 1 start)"
+            )
+        trainer_b.train()
+        art_b = export_artifact(trainer_b, os.path.join(root, "art_b"))
+        trainer_b.close()
+        for site, n in reg_b.fired().items():
+            expected_fires[site] = expected_fires.get(site, 0) + n
+        chaos.disarm()
+
+        eng_b = PredictEngine.load(art_b, buckets=(64,), warm=False)
+        p_b = eng_b.predict(eng_b.featurize_raw(probes))
+        worst_train = float(np.abs(p_b - p_ref).max())
+        if not np.allclose(p_b, p_ref, atol=PARITY_ATOL):
+            errors.append(
+                f"kill→auto-resume→export diverged from the fault-free "
+                f"run (max |diff| {worst_train:.2e} > {PARITY_ATOL})"
+            )
+
+        rows = load_jsonl(metrics)
+        errors.extend(validate_rows(rows))
+        by_site: dict[str, int] = {}
+        for r in rows:
+            if r.get("kind") == "chaos":
+                by_site[r["site"]] = by_site.get(r["site"], 0) + 1
+        causes: dict[str, int] = {}
+        for r in rows:
+            if r.get("kind") == "health":
+                causes[r["cause"]] = causes.get(r["cause"], 0) + 1
+        dropped = reg_a.dropped_rows() + reg_b.dropped_rows()
+        for site, n in expected_fires.items():
+            if by_site.get(site, 0) != n:
+                errors.append(
+                    f"fault accounting: site {site} fired {n}x but "
+                    f"{by_site.get(site, 0)} chaos row(s) logged "
+                    f"({dropped} row(s) dropped at logging)"
+                )
+        # every injected fault pairs with the row of the layer that
+        # healed (or loudly reported) it
+        pairs = {
+            "ckpt.finalize": "checkpoint_save_failed",
+            "loader.read_block": "recovered:io_retry",
+        }
+        for site, cause in pairs.items():
+            if expected_fires.get(site) and not causes.get(cause):
+                errors.append(
+                    f"fault at {site} has no matching {cause!r} health "
+                    "row — the heal was silent"
+                )
+        if not causes.get("checkpoint_fallback"):
+            errors.append(
+                "restore auto skipped the corrupt generation without a "
+                "checkpoint_fallback health row"
+            )
+        n_train_rows = len(rows)
+
+        # -- part 2: fleet under scoring faults ----------------------------
+        fleet_ref = ReplicaFleet.load(
+            art_ref, replicas=2, buckets=(1, 8), warm=False
+        )
+        s_ref = np.asarray([fleet_ref.score(k) for k in probes])
+        fleet_ref.close()
+
+        serve_metrics = os.path.join(root, "serve.jsonl")
+        logger = MetricsLogger(serve_metrics, run_header={
+            "run_id": "chaos-gate-serve",
+            "config_digest": fleet_ref.digest,
+            "rank": 0,
+            "num_hosts": 1,
+            "model": "lr",
+        })
+        reg = chaos.arm("seed=5;serve.replica_score:p=1,times=2")
+        chaos.attach_logger(logger)
+        fleet = ReplicaFleet.load(
+            art_ref, replicas=2, buckets=(1, 8), warm=False,
+            metrics_logger=logger, evict_after_errors=1,
+        )
+        summary = run_loadgen(
+            fleet,
+            offered_qps=100.0,
+            duration_s=1.0,
+            concurrency=4,
+            nnz=8,
+            zipf_a=1.3,
+            seed=0,
+            metrics_logger=logger,
+        )
+        # wait for the background revives to land
+        deadline = time.perf_counter() + 20.0
+        while time.perf_counter() < deadline:
+            health = fleet.health()
+            if not health["unhealthy"] and (
+                health["revivals"] >= health["evictions"]
+            ):
+                break
+            time.sleep(0.05)
+        health = fleet.health()
+        fires = reg.fired().get("serve.replica_score", 0)
+        if fires < 1:
+            errors.append("serve.replica_score never fired under load")
+        if summary["errors"] < 1:
+            errors.append(
+                "injected scoring faults produced no client-visible "
+                "errors — they were silently swallowed somewhere"
+            )
+        if health["evictions"] < 1:
+            errors.append(
+                f"no replica eviction despite {fires} scoring fault(s) "
+                f"at evict_after_errors=1 (health {health})"
+            )
+        if health["unhealthy"] or health["revivals"] < health["evictions"]:
+            errors.append(
+                f"fleet did not return to full health: {health}"
+            )
+        s_chaos = np.asarray([fleet.score(k) for k in probes])
+        worst_serve = float(np.abs(s_chaos - s_ref).max())
+        if not np.allclose(s_chaos, s_ref, atol=PARITY_ATOL):
+            errors.append(
+                f"post-revive fleet scores diverge from the fault-free "
+                f"fleet (max |diff| {worst_serve:.2e} > {PARITY_ATOL})"
+            )
+        fleet.close()
+        chaos.detach_logger(logger)
+        chaos.disarm()
+        logger.close()
+
+        srows = load_jsonl(serve_metrics)
+        errors.extend(validate_rows(srows))
+        n_chaos_rows = sum(
+            1 for r in srows
+            if r.get("kind") == "chaos"
+            and r.get("site") == "serve.replica_score"
+        )
+        if n_chaos_rows != fires:
+            errors.append(
+                f"serve fault accounting: {fires} fires vs "
+                f"{n_chaos_rows} chaos row(s) "
+                f"({reg.dropped_rows()} dropped at logging)"
+            )
+        scauses: dict[str, int] = {}
+        for r in srows:
+            if r.get("kind") == "health":
+                scauses[r["cause"]] = scauses.get(r["cause"], 0) + 1
+        if scauses.get("replica_evicted", 0) != health["evictions"]:
+            errors.append(
+                f"{health['evictions']} eviction(s) vs "
+                f"{scauses.get('replica_evicted', 0)} replica_evicted "
+                "health row(s)"
+            )
+        if scauses.get("replica_revived", 0) != health["revivals"]:
+            errors.append(
+                f"{health['revivals']} revival(s) vs "
+                f"{scauses.get('replica_revived', 0)} replica_revived "
+                "health row(s)"
+            )
+
+        leaked = _leaked_threads()
+        if leaked:
+            errors.append(f"leaked thread(s) survived the runs: {leaked}")
+
+    for e in errors:
+        print(f"FAIL: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print(
+        f"OK: kill→auto-resume parity max|diff|={worst_train:.1e}; "
+        f"fleet evict/revive parity max|diff|={worst_serve:.1e} "
+        f"({health['evictions']} evicted, {health['revivals']} revived, "
+        f"{summary['errors']} client error(s) under load); "
+        f"{sum(expected_fires.values()) + fires} injected fault(s) all "
+        f"accounted for; {n_train_rows}+{len(srows)} metrics rows "
+        "validated; no leaked threads"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1071,6 +1071,102 @@ def test_xf009_bounded_loop_cold_module_main_context_silent(tmp_path):
     assert findings == []
 
 
+# -- XF015: swallowed worker exceptions -----------------------------------
+
+_XF015_TEMPLATE = (
+    "import threading\n"
+    "class Pump:\n"
+    "    def __init__(self, obs):\n"
+    "        self.obs = obs\n"
+    "        self._t = threading.Thread(target=self._run)\n"
+    "    def _run(self):\n"
+    "        try:\n"
+    "            self.step()\n"
+    "        {handler}\n"
+    "    def step(self):\n"
+    "        pass\n"
+)
+
+
+def test_xf015_silent_worker_swallow_fires(tmp_path):
+    findings, _ = scan(tmp_path, {
+        "pump.py": _XF015_TEMPLATE.format(
+            handler="except Exception:\n            pass"
+        ),
+    }, select=["XF015"])
+    assert len(findings) == 1
+    assert "swallows" in findings[0].message
+    assert "_run" in findings[0].message
+
+
+def test_xf015_bare_except_fires_too(tmp_path):
+    findings, _ = scan(tmp_path, {
+        "pump.py": _XF015_TEMPLATE.format(
+            handler="except:\n            return"
+        ),
+    }, select=["XF015"])
+    assert len(findings) == 1
+
+
+@pytest.mark.parametrize("handler", [
+    # re-raise
+    "except Exception:\n            raise",
+    # propagate the exception object into a call (set_exception shape)
+    "except Exception as e:\n            self.obs.put(e)",
+    # loud reporting surface (health_row / counter / warn family)
+    "except Exception:\n            self.obs.counter('pump.err')",
+    # exception woven into a reported message
+    "except Exception as e:\n"
+    "            self.obs.record(f'died: {e}')",
+])
+def test_xf015_loud_handlers_are_silent(tmp_path, handler):
+    findings, _ = scan(tmp_path, {
+        "pump.py": _XF015_TEMPLATE.format(handler=handler),
+    }, select=["XF015"])
+    assert findings == []
+
+
+def test_xf015_narrow_and_main_context_exempt(tmp_path):
+    findings, _ = scan(tmp_path, {
+        # narrow idiom (queue.Empty-style control flow): exempt
+        "narrow.py": _XF015_TEMPLATE.format(
+            handler="except ValueError:\n            pass"
+        ),
+        # same swallow, but main-context (no thread seeds it): exempt
+        "mainctx.py": (
+            "def drain(q):\n"
+            "    try:\n"
+            "        q.get()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        ),
+    }, select=["XF015"])
+    assert findings == []
+
+
+def test_xf015_pragma_suppresses(tmp_path):
+    findings, suppressed = scan(tmp_path, {
+        "pump.py": _XF015_TEMPLATE.format(
+            handler="except Exception:  # xf: ignore[XF015]\n"
+            "            pass"
+        ),
+    }, select=["XF015"])
+    assert findings == []
+    assert [f.rule for f in suppressed] == ["XF015"]
+
+
+def test_xf015_handler_in_nested_def_not_credited(tmp_path):
+    """A reporting call inside a nested def the handler merely DEFINES
+    does not make the swallow loud."""
+    findings, _ = scan(tmp_path, {
+        "pump.py": _XF015_TEMPLATE.format(
+            handler="except Exception:\n"
+            "            cb = lambda: self.obs.counter('x')"
+        ),
+    }, select=["XF015"])
+    assert len(findings) == 1
+
+
 # -- runtime sanitizer (analysis/sanitizer.py) ----------------------------
 
 

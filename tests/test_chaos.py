@@ -1,0 +1,713 @@
+"""Chaos fabric (xflow_tpu/chaos/; docs/ROBUSTNESS.md): seeded
+deterministic failpoints, per-site self-healing fixtures, doctor
+diagnosis, and the tier-1 gate wiring.
+
+Per-site coverage:
+
+* registry — spec grammar, deterministic fire schedules (nth/every/
+  p/times), zero-overhead disarmed path, chaos-row audit trail;
+* loader — transient read healed by bounded retry (identical batches),
+  persistent corruption quarantined (skip + health row), quarantine
+  budget abort;
+* checkpoint — latest_complete / manifest-less refusal, kill
+  mid-commit leaves the previous generation restorable, restore-auto
+  fallback walks past broken generations;
+* store — promotion-worker death detected between steps and restarted
+  once (second death freezes placement, training stays correct),
+  transient cold-fetch healed by retry;
+* serve — replica eviction + background revive from the shared
+  artifact, accept-loop failpoint survived;
+* doctor — quarantine-budget blamed as corruption (not input stall),
+  evict/revive ranked as absorbed vs reduced-capacity.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from xflow_tpu import chaos
+from xflow_tpu.config import Config
+from xflow_tpu.trainer import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with the global registry disarmed —
+    an armed leftover would inject faults into unrelated tests."""
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+@pytest.fixture(scope="module")
+def toy_dataset(tmp_path_factory):
+    from tests.gen_data import generate_dataset
+
+    root = tmp_path_factory.mktemp("chaos_data")
+    return generate_dataset(
+        str(root),
+        num_train_shards=2,
+        lines_per_shard=200,
+        num_fields=10,
+        vocab_per_field=8,
+        seed=5,
+        scale=3.0,
+    )
+
+
+def _cfg(ds, **kw):
+    base = dict(
+        train_path=ds.train_prefix,
+        test_path=ds.test_prefix,
+        model="lr",
+        epochs=1,
+        batch_size=64,
+        table_size_log2=14,
+        max_nnz=16,
+        num_devices=1,
+        parse_workers=1,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+class _FakeLogger:
+    def __init__(self):
+        self.rows = []
+        self.closed = False
+
+    def log(self, kind, record):
+        row = {"t": 0.0, "kind": kind}
+        row.update(record)
+        self.rows.append(row)
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_parse_spec_grammar():
+    seed, rules = chaos.parse_spec(
+        "seed=9; loader.read_block:nth=2 ; serve.replica_score:p=0.5,times=3"
+    )
+    assert seed == 9
+    assert rules["loader.read_block"].nth == 2
+    assert rules["serve.replica_score"].p == 0.5
+    assert rules["serve.replica_score"].times == 3
+
+
+@pytest.mark.parametrize("bad", [
+    "",
+    "seed=1",
+    "site-with-caps!:p=1",
+    "a.b",
+    "a.b:frob=1",
+    "a.b:p=2",
+    "a.b:nth=0",
+    "a.b:nth=1;a.b:nth=2",
+    "a.b:p=0.5,nth=3",
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        chaos.parse_spec(bad)
+
+
+def test_config_validates_chaos_spec():
+    with pytest.raises(ValueError):
+        Config(chaos_spec="not a spec")
+    assert Config(chaos_spec="a.b:nth=1").chaos_spec == "a.b:nth=1"
+
+
+def test_arm_from_env(monkeypatch):
+    """XFLOW_CHAOS reaches every entry point (Trainer and the serve
+    CLI both arm through this helper); unset = no-op, keeping
+    whatever is armed."""
+    monkeypatch.delenv("XFLOW_CHAOS", raising=False)
+    assert chaos.arm_from_env() is None
+    chaos.arm("x.y:nth=1")
+    assert chaos.arm_from_env() is None  # unset must not disarm
+    assert chaos.armed() is not None
+    monkeypatch.setenv("XFLOW_CHAOS", "a.b:nth=2")
+    reg = chaos.arm_from_env()
+    assert reg is chaos.armed() and "a.b" in reg.rules
+
+
+def test_disarmed_failpoint_is_noop():
+    assert chaos.armed() is None
+    chaos.failpoint("anything.at.all")  # no raise, no state, no logger
+    assert chaos.fired() == {}
+
+
+def test_deterministic_fire_schedule():
+    """Same seed + same hit sequence → identical fire pattern, on two
+    independent registries (the reproducibility the gate rides on)."""
+
+    def pattern(spec):
+        reg = chaos.arm(spec)
+        fired = []
+        for i in range(64):
+            try:
+                chaos.failpoint("x.y")
+                fired.append(False)
+            except chaos.ChaosError:
+                fired.append(True)
+        chaos.disarm()
+        return fired, reg.fired()
+
+    a, fa = pattern("seed=4;x.y:p=0.25")
+    b, fb = pattern("seed=4;x.y:p=0.25")
+    c, _ = pattern("seed=5;x.y:p=0.25")
+    assert a == b and fa == fb
+    assert any(a) and not all(a)
+    assert c != a  # a different seed moves the schedule
+
+
+def test_nth_every_times_semantics():
+    chaos.arm("x.y:every=3,times=2")
+    hits = []
+    for i in range(1, 13):
+        try:
+            chaos.failpoint("x.y")
+        except chaos.ChaosError as e:
+            hits.append(e.hit)
+    assert hits == [3, 6]  # every=3 capped at times=2
+
+
+def test_chaos_rows_logged_and_schema_valid():
+    from xflow_tpu.obs.schema import validate_rows
+
+    log = _FakeLogger()
+    chaos.arm("x.y:nth=1")
+    chaos.attach_logger(log)
+    with pytest.raises(chaos.ChaosError):
+        chaos.failpoint("x.y")
+    assert [r["kind"] for r in log.rows] == ["chaos"]
+    assert log.rows[0]["site"] == "x.y"
+    assert validate_rows(log.rows) == []
+    # detach of a DIFFERENT logger must not steal the attachment
+    chaos.detach_logger(object())
+    assert chaos.armed()._logger is log
+
+
+# -- loader -----------------------------------------------------------------
+
+
+def _collect_batches(ds, cfg):
+    trainer = Trainer(cfg)
+    loader = trainer._loader(
+        ds.train_prefix + "-00000"
+    )
+    out = [b for b, _ in loader.iter_batches()]
+    trainer.close()
+    return out, loader
+
+
+def test_loader_transient_read_heals_with_identical_batches(toy_dataset):
+    clean, _ = _collect_batches(toy_dataset, _cfg(toy_dataset))
+    chaos.arm("loader.read_block:nth=1")
+    healed, loader = _collect_batches(toy_dataset, _cfg(toy_dataset))
+    assert chaos.fired() == {"loader.read_block": 1}
+    assert len(healed) == len(clean)
+    for a, b in zip(clean, healed):
+        np.testing.assert_array_equal(a.keys, b.keys)
+        np.testing.assert_array_equal(a.labels, b.labels)
+    assert loader._quarantined == 0
+
+
+def test_loader_persistent_corruption_quarantines(toy_dataset):
+    """A block that fails past the retry budget is SKIPPED (health row
+    + counter), not fatal — and the stream keeps going."""
+    clean, _ = _collect_batches(toy_dataset, _cfg(toy_dataset))
+    # nth=1 keeps firing only on hit 1..  every retry re-hits, so use
+    # p=1,times=N with N > io_retries to exhaust one block's budget
+    chaos.arm("loader.parse_record:p=1,times=3")
+    cfg = _cfg(toy_dataset, io_retries=2, io_retry_backoff_s=0.0)
+    healed, loader = _collect_batches(toy_dataset, cfg)
+    assert loader._quarantined == 1
+    assert len(healed) < len(clean)  # the block's samples are gone
+
+
+def test_loader_quarantine_budget_aborts(toy_dataset):
+    from xflow_tpu.io.loader import QuarantineExceeded
+
+    chaos.arm("loader.parse_record:p=1")  # every block, forever
+    cfg = _cfg(toy_dataset, io_retries=0, max_quarantined_frac=0.05)
+    trainer = Trainer(cfg)
+    loader = trainer._loader(toy_dataset.train_prefix + "-00000")
+    # toy shards are one block each: force more blocks per shard
+    loader.block_bytes = 1 << 10
+    with pytest.raises(QuarantineExceeded):
+        for _ in loader.iter_batches():
+            pass
+    trainer.close()
+
+
+def test_loader_health_rows_flow_without_flight_recorder(
+    toy_dataset, tmp_path
+):
+    """The heal is loud whenever a metrics stream exists — the flight
+    recorder being off must not silence recovered:io_retry."""
+    metrics = tmp_path / "m.jsonl"
+    chaos.arm("loader.read_block:nth=1")
+    cfg = _cfg(toy_dataset, metrics_out=str(metrics))
+    trainer = Trainer(cfg)
+    trainer.train()
+    trainer.close()
+    rows = [json.loads(l) for l in metrics.read_text().splitlines()]
+    causes = [r["cause"] for r in rows if r["kind"] == "health"]
+    assert "recovered:io_retry" in causes
+    assert [r["site"] for r in rows if r["kind"] == "chaos"] == [
+        "loader.read_block"
+    ]
+
+
+# -- checkpoint -------------------------------------------------------------
+
+
+def test_latest_complete_and_missing_manifest_refusal(tmp_path):
+    from xflow_tpu.utils.checkpoint import (
+        IncompatibleCheckpoint,
+        checkpoint_candidates,
+        latest_complete,
+        load_checkpoint,
+    )
+
+    ck = tmp_path / "ck"
+    (ck / "ckpt-0000000005").mkdir(parents=True)
+    (ck / "ckpt-0000000005" / "manifest.json").write_text("{}")
+    (ck / "ckpt-0000000009").mkdir()  # newer, no manifest
+    (ck / ".tmp-ckpt-0000000011").mkdir()  # never a candidate
+    assert checkpoint_candidates(str(ck)) == [
+        str(ck / "ckpt-0000000009"), str(ck / "ckpt-0000000005"),
+    ]
+    assert latest_complete(str(ck)) == str(ck / "ckpt-0000000005")
+    with pytest.raises(IncompatibleCheckpoint, match="manifest"):
+        load_checkpoint(str(ck / "ckpt-0000000009"), {"tables": {}})
+
+
+def test_gc_counts_only_complete_generations(tmp_path):
+    """An externally corrupted manifest-less dir must neither occupy a
+    keep slot (leaving < keep restorable generations) nor be deleted
+    (it is evidence)."""
+    from xflow_tpu.utils.checkpoint import gc_checkpoints
+
+    ck = tmp_path / "ck"
+    for step, complete in [(1, True), (2, True), (3, True), (9, False)]:
+        d = ck / f"ckpt-{step:010d}"
+        d.mkdir(parents=True)
+        if complete:
+            (d / "manifest.json").write_text("{}")
+    removed = gc_checkpoints(str(ck), keep=2)
+    left = sorted(p.name for p in ck.iterdir())
+    # oldest complete gen pruned; BOTH newer complete gens survive the
+    # budget despite the newest-sorting corrupt dir, which stays put
+    assert [os.path.basename(r) for r in removed] == ["ckpt-0000000001"]
+    assert left == [
+        "ckpt-0000000002", "ckpt-0000000003", "ckpt-0000000009",
+    ]
+
+
+def test_dropped_chaos_rows_are_countable():
+    class _Raising:
+        def log(self, kind, record):
+            raise OSError("logger died")
+
+    reg = chaos.arm("x.y:nth=1")
+    chaos.attach_logger(_Raising())
+    with pytest.raises(chaos.ChaosError):
+        chaos.failpoint("x.y")  # the drop must not mask the fault
+    assert reg.dropped_rows() == 1
+    assert reg.fired() == {"x.y": 1}
+
+
+def test_writeback_heal_on_checkpoint_path_is_loud(toy_dataset, tmp_path):
+    """A store.writeback transient healed during the PRE-CHECKPOINT
+    flush (a no-per-call-obs path) still emits its recovery row —
+    'recovery is never silent' holds on every call path."""
+    metrics = tmp_path / "m.jsonl"
+    ck = tmp_path / "ck"
+    cfg = _tiered_cfg(
+        toy_dataset, metrics_out=str(metrics), checkpoint_dir=str(ck)
+    )
+    t = Trainer(cfg)
+    try:
+        # fresh store: the first batch's keys all MISS, so dispatch
+        # leaves a non-empty pending write-back for save to flush
+        loader = t._loader(toy_dataset.train_prefix + "-00000")
+        batch = next(loader.iter_batches())[0]
+        arrays = t.step.put_batch(batch)
+        t.state, _ = t.step.dispatch_train(t.state, arrays)
+        reg = chaos.arm("store.writeback:nth=1")
+        t.save(0, 0)
+        assert reg.fired() == {"store.writeback": 1}
+    finally:
+        t.close()
+    rows = [json.loads(l) for l in metrics.read_text().splitlines()]
+    causes = [r["cause"] for r in rows if r["kind"] == "health"]
+    assert "recovered:io_retry" in causes
+
+
+def test_checkpoint_keep_default_prunes(toy_dataset, tmp_path):
+    """keep-last-N GC (default 2): a run that checkpoints every few
+    steps ends with at most 2 committed generations."""
+    ck = tmp_path / "ck"
+    cfg = _cfg(
+        toy_dataset, checkpoint_dir=str(ck), checkpoint_every_steps=2
+    )
+    assert cfg.checkpoint_keep == 2
+    t = Trainer(cfg)
+    t.train()
+    t.close()
+    gens = [d for d in os.listdir(ck) if d.startswith("ckpt-")]
+    assert 1 <= len(gens) <= 2
+
+
+def test_kill_mid_commit_then_resume_auto_parity(toy_dataset, tmp_path):
+    """The tentpole invariant in miniature: epoch-0 generation commits,
+    the epoch-1 save is killed mid-commit, resume auto restores the
+    complete generation and retraining converges to the fault-free
+    weights exactly."""
+    ref = Trainer(_cfg(toy_dataset, epochs=2))
+    ref.train()
+    w_ref = np.asarray(ref.state["tables"]["w"]["param"])
+    ref.close()
+
+    ck = tmp_path / "ck"
+    cfg = _cfg(toy_dataset, epochs=2, checkpoint_dir=str(ck))
+    chaos.arm("ckpt.finalize:nth=2")
+    t1 = Trainer(cfg)
+    with pytest.raises(chaos.ChaosError):
+        t1.train()
+    t1.close()
+    chaos.disarm()
+
+    t2 = Trainer(cfg)
+    cursor = t2.restore(auto=True)
+    assert cursor is not None and cursor["epoch"] == 1
+    t2.train()
+    w2 = np.asarray(t2.state["tables"]["w"]["param"])
+    t2.close()
+    np.testing.assert_allclose(w2, w_ref, atol=1e-6)
+
+
+def test_restore_auto_falls_back_past_failing_candidate(
+    toy_dataset, tmp_path
+):
+    """ckpt.restore firing on the newest generation (transient restore
+    error) makes auto mode fall back to the next one; plain mode
+    propagates."""
+    ck = tmp_path / "ck"
+    # checkpoint_every_steps yields several distinct generations;
+    # keep-last-N (default 2) retains two
+    cfg = _cfg(toy_dataset, checkpoint_dir=str(ck), checkpoint_every_steps=3)
+    t = Trainer(cfg)
+    t.train()
+    t.close()
+    from xflow_tpu.utils.checkpoint import checkpoint_candidates
+
+    assert len(checkpoint_candidates(str(ck))) == 2
+
+    chaos.arm("ckpt.restore:nth=1")
+    t2 = Trainer(cfg)
+    cursor = t2.restore(auto=True)
+    assert cursor is not None  # healed by falling back
+    t2.close()
+
+    chaos.arm("ckpt.restore:nth=1")
+    t3 = Trainer(cfg)
+    with pytest.raises(chaos.ChaosError):
+        t3.restore()  # plain mode: the error propagates
+    t3.close()
+
+
+# -- store ------------------------------------------------------------------
+
+
+def _tiered_cfg(ds, **kw):
+    return _cfg(
+        ds,
+        model="fm",
+        table_size_log2=16,
+        store_mode="tiered",
+        hot_capacity_log2=10,
+        **kw,
+    )
+
+
+def test_promote_worker_death_restarted_once(toy_dataset, tmp_path):
+    metrics = tmp_path / "m.jsonl"
+    chaos.arm("store.promote_worker:nth=1")
+    t = Trainer(_tiered_cfg(toy_dataset, metrics_out=str(metrics)))
+    t.train()
+    store = t.step.store
+    assert store._promoter_restarts == 1
+    assert not store._promoter_dead
+    assert store.promoter.alive()  # the restarted worker is live
+    t.close()
+    rows = [json.loads(l) for l in metrics.read_text().splitlines()]
+    causes = [r["cause"] for r in rows if r["kind"] == "health"]
+    assert "store_promote_restarted" in causes
+
+
+def test_promote_worker_second_death_freezes_placement(
+    toy_dataset, tmp_path
+):
+    metrics = tmp_path / "m.jsonl"
+    chaos.arm("store.promote_worker:every=1,times=2")
+    t = Trainer(
+        _tiered_cfg(toy_dataset, epochs=2, metrics_out=str(metrics))
+    )
+    t.train()  # must COMPLETE: placement frozen, training correct
+    store = t.step.store
+    assert store._promoter_dead
+    t.close()
+    rows = [json.loads(l) for l in metrics.read_text().splitlines()]
+    causes = [r["cause"] for r in rows if r["kind"] == "health"]
+    assert "store_promote_dead" in causes
+    leaked = [
+        th.name for th in threading.enumerate()
+        if th.name.startswith("store-promote") and th.is_alive()
+    ]
+    assert leaked == []
+
+
+def test_cold_fetch_transient_healed(toy_dataset, tmp_path):
+    metrics = tmp_path / "m.jsonl"
+    chaos.arm("store.cold_fetch:nth=2")
+    t = Trainer(_tiered_cfg(toy_dataset, metrics_out=str(metrics)))
+    t.train()
+    t.close()
+    assert chaos.fired() == {"store.cold_fetch": 1}
+    rows = [json.loads(l) for l in metrics.read_text().splitlines()]
+    causes = [r["cause"] for r in rows if r["kind"] == "health"]
+    assert "recovered:io_retry" in causes
+
+
+# -- serve ------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lr_artifact(toy_dataset, tmp_path_factory):
+    from xflow_tpu.serve.artifact import export_artifact
+
+    chaos.disarm()  # module fixture builds before the autouse fixture
+    trainer = Trainer(_cfg(toy_dataset))
+    trainer.train()
+    art = str(tmp_path_factory.mktemp("chaos_serve") / "artifact")
+    export_artifact(trainer, art)
+    trainer.close()
+    return art
+
+
+def test_fleet_evicts_and_revives(lr_artifact):
+    from xflow_tpu.serve.fleet import ReplicaFleet
+
+    log = _FakeLogger()
+    fleet = ReplicaFleet.load(
+        lr_artifact, replicas=2, buckets=(1, 4), warm=False,
+        metrics_logger=log, evict_after_errors=1,
+    )
+    ref = fleet.score(np.array([3, 5, 7]))
+    chaos.arm("serve.replica_score:p=1,times=1")
+    chaos.attach_logger(log)
+    with pytest.raises(chaos.ChaosError):
+        fleet.score(np.array([3, 5, 7]))
+    deadline = time.perf_counter() + 15.0
+    while time.perf_counter() < deadline:
+        h = fleet.health()
+        if not h["unhealthy"] and h["revivals"] >= 1:
+            break
+        time.sleep(0.02)
+    h = fleet.health()
+    assert h["evictions"] == 1 and h["revivals"] == 1
+    assert h["unhealthy"] == []
+    # the revived clone serves the same artifact state
+    assert fleet.score(np.array([3, 5, 7])) == pytest.approx(
+        ref, abs=1e-6
+    )
+    assert fleet.stats()["health"]["revivals"] == 1
+    fleet.close()
+    causes = [r["cause"] for r in log.rows if r["kind"] == "health"]
+    assert causes.count("replica_evicted") == 1
+    assert causes.count("replica_revived") == 1
+
+
+def test_all_replicas_evicted_sheds_typed(lr_artifact):
+    from xflow_tpu.serve.fleet import ReplicaFleet, ShedError
+
+    fleet = ReplicaFleet.load(
+        lr_artifact, replicas=1, buckets=(1, 4), warm=False,
+        evict_after_errors=1, revive=False,
+    )
+    chaos.arm("serve.replica_score:p=1,times=1")
+    with pytest.raises(chaos.ChaosError):
+        fleet.score(np.array([1]))
+    deadline = time.perf_counter() + 5.0
+    while time.perf_counter() < deadline and not fleet.health()["evictions"]:
+        time.sleep(0.01)
+    with pytest.raises(ShedError) as ei:
+        fleet.submit(np.array([1]))
+    assert ei.value.cause == "replica_unavailable"
+    shed = fleet.close()["shed"]
+    assert shed["by_cause"].get("replica_unavailable", 0) >= 1
+
+
+def test_serve_accept_failpoint_survives(lr_artifact):
+    """An injected accept-loop fault must not kill serve_forever: the
+    tier keeps answering after the fires."""
+    import urllib.request
+
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.server import ServeTier
+
+    fleet = ReplicaFleet.load(
+        lr_artifact, replicas=1, buckets=(1, 4), warm=False
+    )
+    chaos.arm("serve.accept:every=1,times=3")
+    tier = ServeTier(fleet, poll_s=0.02)
+    tier.start()
+    try:
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline and tier.accept_faults < 3:
+            time.sleep(0.02)
+        assert tier.accept_faults == 3
+        with urllib.request.urlopen(
+            tier.address + "/healthz", timeout=10
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["status"] == "serving"
+    finally:
+        tier.close()
+
+
+# -- doctor -----------------------------------------------------------------
+
+
+def _health(cause, channel="loader"):
+    return {
+        "t": 1.0, "kind": "health", "cause": cause, "channel": channel,
+        "silence_seconds": 0.0, "threshold_seconds": 0.0,
+        "detail": "", "channels": {},
+    }
+
+
+def _chaos_row(site):
+    return {
+        "t": 1.0, "kind": "chaos", "site": site, "hit": 1, "fires": 1,
+        "detail": "seed=0",
+    }
+
+
+def test_doctor_blames_quarantine_budget_not_input_stall():
+    from xflow_tpu.obs.doctor import diagnose
+
+    rows = [
+        _chaos_row("loader.parse_record"),
+        _health("record_quarantined"),
+        _health("quarantine_budget_exceeded"),
+    ]
+    findings = diagnose(rows)
+    crit = [d for d in findings if d.severity == "crit"]
+    assert any(d.code == "quarantine_budget_exceeded" for d in crit)
+    assert any("NOT an input stall" in d.message for d in crit)
+    # and no generic watchdog-trip misreading of the same rows
+    assert not any(
+        "watchdog tripped" in d.message for d in findings
+    )
+
+
+def test_doctor_ranks_absorbed_vs_unrevived_eviction():
+    from xflow_tpu.obs.doctor import diagnose
+
+    absorbed = diagnose([
+        _chaos_row("serve.replica_score"),
+        _health("replica_evicted", "serve"),
+        _health("replica_revived", "serve"),
+    ])
+    d = next(d for d in absorbed if d.code == "replica_evicted")
+    assert d.severity == "info" and "revived" in d.message
+    assert any(d.code == "chaos_absorbed" for d in absorbed)
+
+    stuck = diagnose([
+        _chaos_row("serve.replica_score"),
+        _health("replica_evicted", "serve"),
+    ])
+    d = next(d for d in stuck if d.code == "replica_evicted")
+    assert d.severity == "warn" and "reduced capacity" in d.message
+    assert any(d.code == "fault_storm" for d in stuck)
+
+
+def test_doctor_flags_real_heals_without_chaos_rows():
+    """Production faults (chaos disarmed, no `chaos` rows) must still
+    produce a verdict: failing checkpoint saves and under-budget
+    quarantines are warnings, not silence."""
+    from xflow_tpu.obs.doctor import diagnose
+
+    findings = diagnose([
+        _health("checkpoint_save_failed", "train"),
+        _health("record_quarantined"),
+    ])
+    codes = {d.code: d.severity for d in findings}
+    assert codes.get("checkpoint_save_failed") == "warn"
+    assert codes.get("record_quarantined") == "warn"
+    # budget-exceeded escalates to the crit and subsumes the warn
+    findings = diagnose([
+        _health("record_quarantined"),
+        _health("quarantine_budget_exceeded"),
+    ])
+    codes = {d.code: d.severity for d in findings}
+    assert codes.get("quarantine_budget_exceeded") == "crit"
+    assert "record_quarantined" not in codes
+    # a fallback-only stream (silent training rewind) is NOT healthy
+    findings = diagnose([_health("checkpoint_fallback", "train")])
+    codes = {d.code: d.severity for d in findings}
+    assert codes.get("checkpoint_fallback") == "warn"
+
+
+def test_config_armed_schedule_dies_with_trainer(toy_dataset):
+    """A chaos_spec-armed schedule's lifetime is its Trainer's: close()
+    disarms it so later non-chaos Trainers in the same process never
+    inherit injected faults.  Directly/env-armed registries survive."""
+    t = Trainer(_cfg(toy_dataset, chaos_spec="loader.read_block:nth=999"))
+    assert chaos.armed() is not None
+    t.close()
+    assert chaos.armed() is None
+    reg = chaos.arm("x.y:nth=1")  # armed outside any trainer
+    t2 = Trainer(_cfg(toy_dataset))
+    t2.close()
+    assert chaos.armed() is reg
+
+
+def test_doctor_healthy_stream_has_no_chaos_findings():
+    from xflow_tpu.obs.doctor import diagnose
+
+    findings = diagnose([_health("recovered:io_retry")])
+    assert not any(
+        d.code in ("fault_storm", "chaos_absorbed") for d in findings
+    )
+    assert not any(d.severity in ("crit", "warn") for d in findings)
+
+
+# -- tier-1 gate ------------------------------------------------------------
+
+
+def test_check_chaos_script():
+    """The chaos gate (scripts/check_chaos.py) passes — run as a
+    subprocess exactly as CI would (tier-1 wiring, like
+    check_store_smoke.py)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_chaos.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

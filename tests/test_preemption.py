@@ -148,3 +148,63 @@ def test_midepoch_crash_flushes_metrics_and_flight_dump(
     t.close()
     rows2 = [json.loads(l) for l in out.read_text().splitlines()]
     assert rows2 == rows
+
+
+def test_preemption_mid_checkpoint_resume_auto_roundtrip(
+    big_dataset, tmp_path
+):
+    """ISSUE 11 satellite: a run killed MID-CHECKPOINT (the
+    ckpt.finalize failpoint fires between manifest write and rename —
+    the worst preemption moment) leaves the previous complete
+    generation restorable, and `--resume auto` picks it and runs to
+    completion with a schema-valid metrics stream."""
+    from xflow_tpu import chaos
+    from xflow_tpu.config import Config
+    from xflow_tpu.obs.schema import validate_rows
+    from xflow_tpu.trainer import Trainer
+    from xflow_tpu.utils.checkpoint import latest_complete
+
+    ck = tmp_path / "ck"
+    metrics = tmp_path / "m.jsonl"
+    cfg = Config(
+        train_path=big_dataset.train_prefix,
+        model="lr",
+        epochs=1,
+        batch_size=64,
+        table_size_log2=14,
+        max_nnz=16,
+        num_devices=1,
+        checkpoint_dir=str(ck),
+        checkpoint_every_steps=5,
+        metrics_out=str(metrics),
+    )
+    # the 3rd mid-epoch save dies mid-commit: two complete generations
+    # exist by then, so the fallback has something to restore
+    chaos.arm("ckpt.finalize:nth=3")
+    t1 = Trainer(cfg)
+    try:
+        with pytest.raises(chaos.ChaosError):
+            t1.train()
+    finally:
+        t1.close()
+        chaos.disarm()
+    survivor = latest_complete(str(ck))
+    assert survivor is not None
+
+    t2 = Trainer(cfg)
+    try:
+        cursor = t2.restore(auto=True)
+        assert cursor is not None
+        # mid-shard cursor: the save recorded a real resume offset
+        assert {"shard", "offset"} <= set(cursor["cursors"][0])
+        history = t2.train()
+        assert history and not history[-1].get("preempted")
+    finally:
+        t2.close()
+    rows = [json.loads(l) for l in metrics.read_text().splitlines()]
+    assert validate_rows(rows) == []
+    causes = [r["cause"] for r in rows if r["kind"] == "health"]
+    assert "checkpoint_save_failed" in causes
+    assert [r["site"] for r in rows if r["kind"] == "chaos"] == [
+        "ckpt.finalize"
+    ]

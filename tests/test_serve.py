@@ -1004,6 +1004,52 @@ def test_loadgen_failed_stripe_is_not_a_clean_run(lr_served, monkeypatch):
     assert summary["requests"] + summary["errors"] >= 20, summary
 
 
+def test_http_target_honors_retry_after(monkeypatch):
+    """ISSUE 11 satellite: a typed 429 is RETRIED after honoring
+    Retry-After (capped exponential backoff) instead of booking an
+    immediate shed — chaos runs measure recovery, not just rejection.
+    Exhausted retries still surface as the typed ShedError."""
+    import numpy as np
+
+    from xflow_tpu.serve.fleet import ShedError
+    from xflow_tpu.serve.loadgen import HttpTarget
+    from xflow_tpu.serve.server import encode_packed_response
+
+    shed_body = json.dumps({
+        "error": "backpressure", "cause": "queue_depth",
+        "depth": 9, "queue_age_ms": 1.0, "retry_after_ms": 5,
+    }).encode()
+    ok_body = encode_packed_response(np.asarray([0.25], np.float32))
+
+    target = HttpTarget("http://127.0.0.1:1", max_retries=2)
+    responses = [(429, shed_body, "0.001"), (429, shed_body, "0.001"),
+                 (200, ok_body, "")]
+    posts = []
+    monkeypatch.setattr(
+        target, "_post",
+        lambda path, body: posts.append(path) or responses[len(posts) - 1],
+    )
+    fut = target.submit(np.asarray([1, 2, 3]))
+    assert fut.result(0) == pytest.approx(0.25)
+    assert len(posts) == 3  # two 429s retried, third attempt scored
+    assert target.retried == 2
+
+    # all-429: retries exhaust into the typed shed, counted per retry
+    target2 = HttpTarget("http://127.0.0.1:1", max_retries=1)
+    monkeypatch.setattr(
+        target2, "_post", lambda path, body: (429, shed_body, "0.001")
+    )
+    with pytest.raises(ShedError) as ei:
+        target2.submit(np.asarray([1]))
+    assert ei.value.cause == "queue_depth"
+    assert target2.retried == 1
+
+    # the summary carries the retried count (serve_bench optional field)
+    from xflow_tpu.obs.schema import OPTIONAL
+
+    assert "retried" in OPTIONAL["serve_bench"]
+
+
 def test_watchdog_http_channel_accept_stall():
     """The watchdog classifies http-channel silence (a wedged accept
     loop) as serve_accept_stall — independently of the serve channel —
@@ -1215,7 +1261,7 @@ def test_route_striping_starves_no_replica_and_gates_ignore_stragglers(
         )
         f: Future = Future()
         f.set_result(0.5)
-        fleet._done(f, time.perf_counter(), ro_a)  # straggler from A
+        fleet._done(f, time.perf_counter(), ro_a, 0)  # straggler from A
         assert fleet.rollout_state()["canary_requests"] == 0
         fleet.abort_rollout(detail="test")
     finally:

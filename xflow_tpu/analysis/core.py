@@ -201,6 +201,7 @@ def all_rules() -> list[Rule]:
         ShardingCoverage,
         TransientBudget,
     )
+    from xflow_tpu.analysis.rules_robustness import SwallowedWorkerException
     from xflow_tpu.analysis.rules_schema import SchemaDrift
     from xflow_tpu.analysis.rules_threads import LockDiscipline
 
@@ -219,6 +220,7 @@ def all_rules() -> list[Rule]:
         ShardingCoverage(),
         DonationSafety(),
         TransientBudget(),
+        SwallowedWorkerException(),
     ]
 
 

@@ -1,0 +1,144 @@
+"""XF015 — robustness discipline for worker-context exception
+handling (docs/ROBUSTNESS.md, docs/ANALYSIS.md).
+
+The self-healing fabric's contract is **recovery is never silent**: a
+retried read, a quarantined record, a restarted worker, an evicted
+replica each leave a ``health``/``chaos`` row.  The way that contract
+rots is one ``try/except Exception: pass`` deep inside a worker thread
+— the thread survives, the fault vanishes, and six months later the
+"self-healing" system is silently eating real corruption.  Worker
+context is the dangerous place: an exception swallowed on the main
+thread at least perturbs control flow somewhere visible, while a
+worker's swallow is invisible by construction (nothing joins on it,
+nothing reads its return value).
+
+XF015 therefore demands that every BROAD exception handler (bare
+``except:``, ``except Exception``, ``except BaseException`` — narrow
+idioms like ``except queue.Empty: continue`` are expected control
+flow, not swallows) inside a worker-context function (PR 6's
+ConcurrencyContext classification) does at least one of:
+
+* **re-raise** — any ``raise`` in the handler body;
+* **propagate the exception object** — a call that receives the bound
+  exception name (``fut.set_exception(e)``, ``self._put_or_abort(e)``,
+  a message built from ``e``): the fault travels to someone who will
+  act on it;
+* **report loudly** — a call whose leaf name is a known reporting
+  surface (``health_row``/``emit_health``/``log``/``counter``/
+  ``warn``/``note_shed``/``note_error``/``flight_dump``/...).
+
+Anything else is a silent worker swallow — fix it or pragma it with a
+justification (``xf: ignore[XF015]``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from xflow_tpu.analysis.core import (
+    Finding,
+    PackageIndex,
+    Rule,
+    dotted_name,
+    walk_scoped,
+)
+from xflow_tpu.analysis.rules_concurrency import get_context
+
+_BROAD = {"Exception", "BaseException"}
+
+# leaf names that count as loud reporting even without the exception
+# object in hand (counters and health rows carry their own context)
+_REPORT_LEAVES = {
+    "health_row",
+    "emit_health",
+    "log",
+    "counter",
+    "counter_add",
+    "warn",
+    "warning",
+    "error",
+    "exception",
+    "note_error",
+    "note_shed",
+    "set_exception",
+    "flight_dump",
+    "put_nowait",
+}
+
+
+def _leaf_of(node: ast.AST) -> str | None:
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+class SwallowedWorkerException(Rule):
+    id = "XF015"
+    title = "worker-context handler swallows exceptions silently"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        ctx = get_context(index)
+        for fn in ctx.fns:
+            if not fn.is_worker:
+                continue
+            for node in walk_scoped(fn.node):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    if not self._is_broad(handler):
+                        continue
+                    if self._handles_loudly(handler):
+                        continue
+                    yield Finding(
+                        rule=self.id,
+                        path=fn.sf.rel,
+                        line=handler.lineno,
+                        message=(
+                            f"broad except in worker-context "
+                            f"{fn.qualname}() swallows the exception "
+                            "silently — a worker's swallow is "
+                            "invisible by construction (nothing joins "
+                            "it, nothing reads its return); re-raise, "
+                            "propagate the exception object, or emit "
+                            "a health/chaos row "
+                            "(docs/ROBUSTNESS.md), or pragma with a "
+                            "justification"
+                        ),
+                    )
+
+    @staticmethod
+    def _is_broad(handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True  # bare except:
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        return any(_leaf_of(e) in _BROAD for e in elts)
+
+    @staticmethod
+    def _handles_loudly(handler: ast.ExceptHandler) -> bool:
+        """Raise / exception-object propagation / reporting call in the
+        handler body (pruned walk: a nested def the handler merely
+        DEFINES doesn't handle anything)."""
+        bound = handler.name
+        stack = list(handler.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef,
+                 ast.Lambda, ast.ClassDef),
+            ):
+                continue
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                leaf = _leaf_of(node.func)
+                if leaf in _REPORT_LEAVES:
+                    return True
+                if bound is not None and any(
+                    isinstance(sub, ast.Name) and sub.id == bound
+                    for sub in ast.walk(node)
+                ):
+                    return True
+            stack.extend(ast.iter_child_nodes(node))
+        return False

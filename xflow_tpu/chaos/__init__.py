@@ -1,0 +1,46 @@
+"""Chaos fabric: seeded deterministic fault injection + the shared
+self-healing primitives (docs/ROBUSTNESS.md).
+
+* :func:`failpoint` — named injection sites threaded through
+  io/loader.py, store/, utils/checkpoint.py, and serve/; zero overhead
+  disarmed, every fire logged as a ``chaos`` JSONL row.
+* :func:`arm` / :func:`disarm` — arm from a chaos-spec string
+  (``Config.chaos_spec`` or the ``XFLOW_CHAOS`` env var).
+* :func:`retry_call` / :func:`emit_health` — the retry-with-backoff and
+  loud-recovery helpers every healed layer shares.
+* ``scripts/check_chaos.py`` — the tier-1 gate that drives a seeded
+  fault schedule through train→checkpoint→kill→auto-resume→export and
+  a loadgen-driven fleet and demands output parity + full fault
+  accounting.
+"""
+
+from xflow_tpu.chaos.heal import emit_health, retry_call
+from xflow_tpu.chaos.registry import (
+    ChaosError,
+    ChaosRegistry,
+    arm,
+    arm_from_env,
+    armed,
+    attach_logger,
+    detach_logger,
+    disarm,
+    failpoint,
+    fired,
+    parse_spec,
+)
+
+__all__ = [
+    "ChaosError",
+    "ChaosRegistry",
+    "arm",
+    "arm_from_env",
+    "armed",
+    "attach_logger",
+    "detach_logger",
+    "disarm",
+    "emit_health",
+    "failpoint",
+    "fired",
+    "parse_spec",
+    "retry_call",
+]

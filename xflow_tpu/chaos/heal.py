@@ -1,0 +1,94 @@
+"""Self-healing primitives shared by the layers the failpoints thread
+through: bounded retry-with-exponential-backoff and loud ``health``-row
+reporting (docs/ROBUSTNESS.md "Policies").
+
+The design rule for every healer in this package: **recovery is never
+silent**.  A retried read, a quarantined record, a restarted worker,
+an evicted replica each leave a ``health`` JSONL row, so `obs doctor`
+can tell a fault storm from an isolated absorbed fault — and analysis
+rule XF015 enforces the same discipline on every worker-context
+exception handler in the tree.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+from xflow_tpu.chaos.registry import ChaosError
+from xflow_tpu.obs import NULL_OBS
+
+# exponential backoff is capped so a misconfigured retry count can
+# never park a hot path for more than ~a second per attempt
+BACKOFF_CAP_S = 1.0
+
+
+def emit_health(
+    obs,
+    cause: str,
+    channel: str,
+    detail: str,
+    silence_seconds: float = 0.0,
+    threshold_seconds: float = 0.0,
+) -> None:
+    """Best-effort ``health`` row through the obs bundle (the loader/
+    store/serve healers all report this way): ``obs.metrics_logger``
+    when the run has a metrics stream, falling back to the flight
+    recorder's logger; no logger anywhere = skipped — the healing
+    itself never depends on observability being on."""
+    flight = getattr(obs, "flight", None)
+    logger = getattr(obs, "metrics_logger", None)
+    if logger is None:
+        logger = getattr(flight, "metrics_logger", None)
+    if logger is None:
+        return
+    from xflow_tpu.obs.schema import health_row
+
+    logger.log("health", health_row(
+        cause=cause,
+        channel=channel,
+        silence_seconds=silence_seconds,
+        threshold_seconds=threshold_seconds,
+        detail=detail,
+        channels=(
+            flight.snapshot()["channels"] if flight is not None else {}
+        ),
+    ))
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    *,
+    attempts: int,
+    backoff_s: float,
+    channel: str,
+    site: str,
+    obs=NULL_OBS,
+    retry_on: tuple = (OSError, ChaosError),
+) -> Any:
+    """Call ``fn`` with up to ``attempts`` retries on ``retry_on``
+    (exponential backoff, capped at :data:`BACKOFF_CAP_S`).  A call
+    that eventually succeeds after failures books a
+    ``<channel>.retries`` counter per retry and ONE
+    ``recovered:io_retry`` health row; exhausted retries re-raise the
+    last error for the caller's quarantine/abort policy."""
+    failures = 0
+    while True:
+        try:
+            out = fn()
+        except retry_on:
+            failures += 1
+            if failures > attempts:
+                raise
+            obs.counter(f"{channel}.retries")
+            time.sleep(min(backoff_s * 2.0 ** (failures - 1), BACKOFF_CAP_S))
+            continue
+        if failures:
+            emit_health(
+                obs,
+                cause="recovered:io_retry",
+                channel=channel,
+                detail=f"{site}: healed after {failures} retried "
+                f"failure(s)",
+            )
+        return out

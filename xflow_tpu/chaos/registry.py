@@ -1,0 +1,304 @@
+"""Seeded deterministic failpoint registry — the injection half of the
+chaos fabric (docs/ROBUSTNESS.md).
+
+Production ads stacks gate releases on fault tolerance, not just
+throughput (the terabyte-scale online-advertising framework,
+arXiv:2201.05500, and Google's ads serving tier, arXiv:2501.10546).
+The failure paths they exercise — corrupt shard records, transient
+reads, half-written checkpoints, dead workers, sick replicas — are
+exactly the paths that rot silently in a repo whose tests only ever
+run the happy path.  This module makes those paths *drivable*: named
+``failpoint(site)`` call sites threaded through the fragile layers
+(io/loader.py, store/, utils/checkpoint.py, serve/) raise an injected
+:class:`ChaosError` on a seeded, fully deterministic schedule, so the
+same spec + seed reproduces the same fault sequence on every run —
+``scripts/check_chaos.py`` gates on it in tier-1.
+
+Arming (``Config.chaos_spec`` or the ``XFLOW_CHAOS`` env var)::
+
+    seed=7;loader.read_block:nth=2;serve.replica_score:p=1,times=4
+
+Grammar: an optional ``seed=<int>`` then ``;``-separated site rules,
+each ``<site>:<arg>(,<arg>)*`` with args
+
+* ``nth=<k>``   — fire on exactly the k-th hit of the site;
+* ``every=<k>`` — fire when the hit count is a multiple of k;
+* ``p=<f>``     — fire with probability f per hit, decided by a
+  splitmix64 hash of (seed, site, hit) — no RNG stream, so concurrent
+  threads hitting other sites never perturb the schedule;
+* ``times=<n>`` — cap total fires at n (combines with any of the
+  above; a rule with only ``times`` fires on every hit until the cap).
+
+Disarmed (the default), ``failpoint()`` is one module-global load and
+a ``None`` compare — zero allocation, zero locking, no logging.  Armed,
+every FIRE logs a schema-valid ``chaos`` JSONL row (obs/schema.py)
+through the attached metrics logger before raising, so the metrics
+stream is the audit trail the chaos gate reconciles against: every
+injected fault must be accounted for by a matching ``chaos`` row and a
+``health`` row from the layer that healed it.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass
+
+_M64 = (1 << 64) - 1
+_SITE_RE = re.compile(r"^[a-z0-9_.]+$")
+
+
+class ChaosError(RuntimeError):
+    """An injected fault.  Deliberately its own type (NOT OSError):
+    self-healing layers must name it in their retry/except lists, so a
+    handler broad enough to swallow injected faults by accident is a
+    handler broad enough to swallow real ones — which is what analysis
+    rule XF015 exists to catch."""
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(
+            f"chaos: injected fault at failpoint {site!r} (hit {hit})"
+        )
+        self.site = site
+        self.hit = hit
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer over python ints (the deterministic
+    per-(seed, site, hit) coin for ``p=`` rules)."""
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def _tag(s: str) -> int:
+    """FNV-1a of the site name — independent fire schedules per site
+    under one seed."""
+    h = 0xCBF29CE484222325
+    for b in s.encode():
+        h = ((h ^ b) * 0x100000001B3) & _M64
+    return h
+
+
+@dataclass
+class _SiteRule:
+    p: float | None = None
+    nth: int | None = None
+    every: int | None = None
+    times: int | None = None
+    hits: int = 0
+    fires: int = 0
+
+
+def parse_spec(spec: str) -> tuple[int, dict[str, _SiteRule]]:
+    """(seed, {site: rule}) for a chaos-spec string; raises ValueError
+    with the grammar on any malformed input (Config.__post_init__
+    validates specs through here, so a bad spec fails at config time,
+    not mid-run)."""
+    seed = 0
+    rules: dict[str, _SiteRule] = {}
+    parts = [p.strip() for p in spec.split(";") if p.strip()]
+    if not parts:
+        raise ValueError(
+            "empty chaos spec (grammar: [seed=<int>;]<site>:<arg>,...)"
+        )
+    if parts[0].startswith("seed="):
+        seed = int(parts[0][len("seed="):])
+        parts = parts[1:]
+    if not parts:
+        raise ValueError("chaos spec has a seed but no site rules")
+    for part in parts:
+        site, sep, argstr = part.partition(":")
+        site = site.strip()
+        if not sep or not _SITE_RE.match(site):
+            raise ValueError(
+                f"bad chaos site rule {part!r} (want "
+                "<site>:<arg>(,<arg>)* with site matching [a-z0-9_.]+)"
+            )
+        if site in rules:
+            raise ValueError(f"duplicate chaos site {site!r}")
+        rule = _SiteRule()
+        for arg in argstr.split(","):
+            key, sep, val = arg.strip().partition("=")
+            if not sep:
+                raise ValueError(f"bad chaos arg {arg!r} (want key=value)")
+            if key == "p":
+                rule.p = float(val)
+                if not 0.0 < rule.p <= 1.0:
+                    raise ValueError(f"chaos p={rule.p} not in (0, 1]")
+            elif key == "nth":
+                rule.nth = int(val)
+                if rule.nth < 1:
+                    raise ValueError("chaos nth must be >= 1")
+            elif key == "every":
+                rule.every = int(val)
+                if rule.every < 1:
+                    raise ValueError("chaos every must be >= 1")
+            elif key == "times":
+                rule.times = int(val)
+                if rule.times < 1:
+                    raise ValueError("chaos times must be >= 1")
+            else:
+                raise ValueError(
+                    f"unknown chaos arg {key!r} (want p/nth/every/times)"
+                )
+        if sum(x is not None for x in (rule.p, rule.nth, rule.every)) > 1:
+            raise ValueError(
+                f"chaos site {site!r}: p/nth/every are mutually exclusive"
+            )
+        rules[site] = rule
+    return seed, rules
+
+
+class ChaosRegistry:
+    """One armed fault schedule.  All mutable state under ``_lock``
+    (hit counters are shared across every thread that crosses a
+    failpoint); the ``chaos`` row is logged OUTSIDE the lock."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.seed, self.rules = parse_spec(spec)
+        self._lock = threading.Lock()
+        self._logger = None
+        self._dropped_rows = 0
+
+    def attach_logger(self, logger) -> None:
+        with self._lock:
+            self._logger = logger
+
+    def detach_logger(self, logger) -> None:
+        """Detach iff ``logger`` is the attached one (a Trainer closing
+        its MetricsLogger must not detach a logger someone else
+        attached after it)."""
+        with self._lock:
+            if self._logger is logger:
+                self._logger = None
+
+    def _should_fire(self, rule: _SiteRule, site: str, hit: int) -> bool:
+        if rule.times is not None and rule.fires >= rule.times:
+            return False
+        if rule.nth is not None:
+            return hit == rule.nth
+        if rule.every is not None:
+            return hit % rule.every == 0
+        if rule.p is not None:
+            coin = (_mix64(self.seed ^ _tag(site) ^ hit) >> 11) * 2.0**-53
+            return coin < rule.p
+        return True
+
+    def hit(self, site: str) -> None:
+        """One crossing of ``site``: count it and raise ChaosError when
+        the rule says to fire (logging the ``chaos`` row first)."""
+        rule = self.rules.get(site)
+        if rule is None:
+            return
+        with self._lock:
+            rule.hits += 1
+            hit = rule.hits
+            fire = self._should_fire(rule, site, hit)
+            if fire:
+                rule.fires += 1
+                fires = rule.fires
+            logger = self._logger
+        if not fire:
+            return
+        if logger is not None:
+            try:
+                logger.log("chaos", {
+                    "site": site,
+                    "hit": hit,
+                    "fires": fires,
+                    "detail": f"seed={self.seed}",
+                })
+            except Exception:
+                # the audit row must never mask the injected fault
+                # itself (a closed logger during teardown is normal);
+                # the drop is still countable  xf: ignore[XF015]
+                with self._lock:
+                    self._dropped_rows += 1
+        raise ChaosError(site, hit)
+
+    def fired(self) -> dict[str, int]:
+        """{site: total fires} — the in-memory half the chaos gate
+        reconciles against the ``chaos`` JSONL rows."""
+        with self._lock:
+            return {
+                site: rule.fires
+                for site, rule in self.rules.items()
+                if rule.fires
+            }
+
+    def hits(self) -> dict[str, int]:
+        with self._lock:
+            return {site: rule.hits for site, rule in self.rules.items()}
+
+    def dropped_rows(self) -> int:
+        """Chaos rows that failed to log (raising/closed logger) — the
+        gate names this count when fires and rows disagree, so a
+        lossy audit trail is distinguishable from a real accounting
+        bug."""
+        with self._lock:
+            return self._dropped_rows
+
+
+_REG: ChaosRegistry | None = None
+_ARM_LOCK = threading.Lock()
+
+
+def arm(spec: str) -> ChaosRegistry:
+    """Arm the process-wide registry from a chaos spec (replacing any
+    previous one — counters restart).  Trainer arms from
+    ``Config.chaos_spec`` / ``XFLOW_CHAOS`` at construction."""
+    global _REG
+    reg = ChaosRegistry(spec)
+    with _ARM_LOCK:
+        _REG = reg
+    return reg
+
+
+def arm_from_env() -> ChaosRegistry | None:
+    """Arm from the XFLOW_CHAOS env var if set (else no-op, keeping
+    whatever is armed).  Trainer and the serve CLI both call this, so
+    the env var reaches every entry point a chaos run drives."""
+    import os
+
+    spec = os.environ.get("XFLOW_CHAOS", "")
+    return arm(spec) if spec else None
+
+
+def disarm() -> None:
+    global _REG
+    with _ARM_LOCK:
+        _REG = None
+
+
+def armed() -> ChaosRegistry | None:
+    return _REG
+
+
+def failpoint(site: str) -> None:
+    """Named fault-injection site.  Disarmed: one global load + None
+    compare (the zero-overhead contract — sites sit on block/record/
+    batch granularity paths, never per-example).  Armed: count the hit
+    and raise :class:`ChaosError` when the site's rule fires."""
+    reg = _REG
+    if reg is not None:
+        reg.hit(site)
+
+
+def attach_logger(logger) -> None:
+    reg = _REG
+    if reg is not None:
+        reg.attach_logger(logger)
+
+
+def detach_logger(logger) -> None:
+    reg = _REG
+    if reg is not None:
+        reg.detach_logger(logger)
+
+
+def fired() -> dict[str, int]:
+    reg = _REG
+    return reg.fired() if reg is not None else {}

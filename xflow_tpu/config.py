@@ -162,7 +162,31 @@ class Config:
     # Keep only the newest K ckpt-* dirs (0 = keep all).  At north-star
     # scale a single FM checkpoint is ~13 GB (2^28 rows x (1+10) cols x
     # 3 arrays x 4 B), so unbounded accumulation fills the disk fast.
-    checkpoint_keep: int = 0
+    # Default 2: the committed generation plus its predecessor, so a
+    # kill mid-commit (the generation a crash-atomic save was
+    # replacing) always leaves a complete fallback for
+    # `--resume auto` (utils/checkpoint.py::latest_complete).
+    checkpoint_keep: int = 2
+
+    # -- robustness (xflow_tpu/chaos/; docs/ROBUSTNESS.md) --
+    # Seeded failpoint schedule, e.g.
+    # "seed=7;loader.read_block:nth=2;serve.replica_score:p=1,times=4"
+    # ("" = disarmed, zero overhead).  The XFLOW_CHAOS env var arms the
+    # same machinery.  Every fire logs a `chaos` JSONL row; the tier-1
+    # chaos gate (scripts/check_chaos.py) reconciles rows against the
+    # schedule and demands model-output parity with the fault-free run.
+    chaos_spec: str = ""
+    # Bounded retry for transient shard-read/parse and cold-store
+    # fetch/write failures (exponential backoff from
+    # io_retry_backoff_s, capped at 1s).  A block that still fails is
+    # QUARANTINED: skipped with a `health` row, not fatal.
+    io_retries: int = 2
+    io_retry_backoff_s: float = 0.05
+    # Quarantine budget: abort the shard stream (health row
+    # `quarantine_budget_exceeded`) once quarantined blocks/records
+    # exceed max(1, ceil(frac * blocks_seen)) — one bad block is
+    # survivable, a corrupt stream is not trainable.
+    max_quarantined_frac: float = 0.05
 
     # -- host data path --
     # Use the native C++ parser (xflow_tpu/native) when a toolchain is
@@ -487,6 +511,18 @@ class Config:
                 )
         if self.store_promote_every < 1:
             raise ValueError("store_promote_every must be >= 1")
+        if self.chaos_spec:
+            from xflow_tpu.chaos import parse_spec
+
+            parse_spec(self.chaos_spec)  # fail at config time, not mid-run
+        if self.io_retries < 0:
+            raise ValueError("io_retries must be >= 0")
+        if self.io_retry_backoff_s < 0:
+            raise ValueError("io_retry_backoff_s must be >= 0")
+        if not 0.0 <= self.max_quarantined_frac <= 1.0:
+            raise ValueError("max_quarantined_frac must be in [0, 1]")
+        if self.checkpoint_keep < 0:
+            raise ValueError("checkpoint_keep must be >= 0")
         if self.transfer_ahead < 1:
             raise ValueError("transfer_ahead must be >= 1")
         if self.obs_trace_capacity < 1:

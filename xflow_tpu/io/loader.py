@@ -19,14 +19,23 @@ New capabilities (gaps filled, SURVEY §5):
 
 from __future__ import annotations
 
+import math
 import queue
 import threading
 import warnings
 from typing import Callable, Iterator
 
+from xflow_tpu.chaos import ChaosError, emit_health, failpoint, retry_call
 from xflow_tpu.io.batch import Batch, ParsedBlock, pack_batch
 from xflow_tpu.io.libffm import BlockReader, parse_block
 from xflow_tpu.obs import NULL_OBS
+
+
+class QuarantineExceeded(RuntimeError):
+    """Quarantined blocks/records exceeded the budget
+    (Config.max_quarantined_frac): the stream is corrupt beyond what
+    skip-and-continue can responsibly absorb — training on the
+    remainder would silently fit a different dataset."""
 
 
 def shard_path(prefix: str, rank: int) -> str:
@@ -97,6 +106,9 @@ class ShardLoader:
         hot_nnz: int = 0,
         obs=None,  # obs.Obs: parse/pack phase seconds + byte counters
         emit_compact: bool = False,  # v2 packed shards: yield CompactBatch
+        io_retries: int = 2,  # transient read/parse retries per block
+        io_retry_backoff_s: float = 0.05,
+        max_quarantined_frac: float = 0.05,  # quarantine budget
     ):
         self.path = path
         self.batch_size = batch_size
@@ -129,6 +141,78 @@ class ShardLoader:
         from xflow_tpu import native
 
         self._native_pack = native.available()
+        # Self-healing (docs/ROBUSTNESS.md): transient read/parse
+        # failures retry with backoff; a block that still fails is
+        # quarantined (skipped + health row) until the budget trips.
+        # Counters shared across parse workers — guarded (XF003/XF008).
+        self.io_retries = io_retries
+        self.io_retry_backoff_s = io_retry_backoff_s
+        self.max_quarantined_frac = max_quarantined_frac
+        self._q_lock = threading.Lock()
+        self._blocks_seen = 0
+        self._quarantined = 0
+
+    # -- self-healing -------------------------------------------------------
+
+    def _parse_block_healed(self, raw: bytes, offset: int) -> ParsedBlock | None:
+        """One block through the failpoint + retry + quarantine fabric.
+        Returns None when the block was quarantined (the stream skips
+        it); raises :class:`QuarantineExceeded` past the budget.
+        Failpoint sites: ``loader.read_block`` (arm as a transient —
+        retries heal it with zero data loss) and ``loader.parse_record``
+        (arm persistent — retries exhaust, the block quarantines)."""
+        with self._q_lock:
+            self._blocks_seen += 1
+
+        def attempt() -> ParsedBlock:
+            failpoint("loader.read_block")
+            failpoint("loader.parse_record")
+            return self._parse_remap(raw)
+
+        try:
+            return retry_call(
+                attempt,
+                attempts=self.io_retries,
+                backoff_s=self.io_retry_backoff_s,
+                channel="loader",
+                site=f"{self.path}@{offset}",
+                obs=self.obs,
+                retry_on=(OSError, ValueError, ChaosError),
+            )
+        except (OSError, ValueError, ChaosError) as e:
+            self._quarantine(offset, e)
+            return None
+
+    def _quarantine(self, offset: int, err: BaseException) -> None:
+        """Skip one unhealable block/record: counter + ``health`` row,
+        then the budget check — quarantine is for isolated corruption,
+        not a license to train past a rotten stream."""
+        self.obs.counter("loader.quarantined")
+        with self._q_lock:
+            self._quarantined += 1
+            quarantined, seen = self._quarantined, self._blocks_seen
+        emit_health(
+            self.obs,
+            cause="record_quarantined",
+            channel="loader",
+            detail=f"{self.path}@{offset}: skipped after "
+            f"{self.io_retries} retries ({type(err).__name__}: {err})",
+        )
+        budget = max(1, math.ceil(self.max_quarantined_frac * seen))
+        if quarantined > budget:
+            emit_health(
+                self.obs,
+                cause="quarantine_budget_exceeded",
+                channel="loader",
+                detail=f"{self.path}: {quarantined} of {seen} blocks "
+                f"quarantined (budget {budget})",
+            )
+            raise QuarantineExceeded(
+                f"{self.path}: {quarantined} quarantined blocks exceed "
+                f"the budget ({budget} of {seen} seen, "
+                f"max_quarantined_frac={self.max_quarantined_frac}) — "
+                f"last error: {type(err).__name__}: {err}"
+            ) from err
 
     def _apply_remap(self, block: ParsedBlock) -> ParsedBlock:
         if (
@@ -204,11 +288,17 @@ class ShardLoader:
             f.seek(start_offset)
 
             def parsed_blocks() -> Iterator[tuple[ParsedBlock, int, int]]:
+                # every block rides _parse_block_healed (retry +
+                # quarantine); a None result is a quarantined block —
+                # skipped, never yielded (resume offsets stay
+                # consistent: the skip consumes the block's bytes)
                 offset = start_offset
                 if parse_workers <= 1:
                     for raw in BlockReader(f, self.block_bytes):
                         next_offset = offset + len(raw)
-                        yield self._parse_remap(raw), offset, next_offset
+                        block = self._parse_block_healed(raw, offset)
+                        if block is not None:
+                            yield block, offset, next_offset
                         offset = next_offset
                     return
                 from collections import deque
@@ -219,15 +309,25 @@ class ShardLoader:
                     for raw in BlockReader(f, self.block_bytes):
                         next_offset = offset + len(raw)
                         pending.append(
-                            (ex.submit(self._parse_remap, raw), offset, next_offset)
+                            (
+                                ex.submit(
+                                    self._parse_block_healed, raw, offset
+                                ),
+                                offset,
+                                next_offset,
+                            )
                         )
                         offset = next_offset
                         while len(pending) > parse_workers + 1:
                             fut, off, noff = pending.popleft()
-                            yield fut.result(), off, noff
+                            block = fut.result()
+                            if block is not None:
+                                yield block, off, noff
                     while pending:
                         fut, off, noff = pending.popleft()
-                        yield fut.result(), off, noff
+                        block = fut.result()
+                        if block is not None:
+                            yield block, off, noff
 
             yield from self._batches_from_blocks(parsed_blocks(), start_offset)
 
@@ -277,7 +377,17 @@ class ShardLoader:
             records = packed.iter_compact_batches(f, start_offset)
         else:
             records = packed.iter_batches(f, start_offset)
-        for batch, _, next_offset in records:
+        for batch, offset, next_offset in records:
+            with self._q_lock:
+                self._blocks_seen += 1
+            try:
+                # the packed-record corruption site: a fire here
+                # quarantines THIS record (skip + health row + budget
+                # check) and the stream continues at the next one
+                failpoint("loader.packed_record")
+            except ChaosError as e:
+                self._quarantine(offset, e)
+                continue
             if flight is not None:
                 flight.note_loader("packed_batch")
             yield batch, next_offset

@@ -73,17 +73,25 @@ class Obs:
     ``note_loader``/``note_serve`` so the watchdog (obs/watchdog.py)
     can classify silence.  None when diagnosis is off: callers guard
     with ``if obs.flight is not None`` (one attribute read per beat
-    site, nothing allocated)."""
+    site, nothing allocated).
 
-    __slots__ = ("tracer", "registry", "flight")
+    ``metrics_logger`` is the ``health``-row sink for the self-healing
+    fabric (xflow_tpu/chaos/heal.py): a retried read, a quarantined
+    record, a restarted worker must be LOUD whenever a metrics stream
+    exists at all — not only when the flight recorder happens to be on
+    (Trainer sets it alongside its MetricsLogger)."""
+
+    __slots__ = ("tracer", "registry", "flight", "metrics_logger")
     enabled = True
 
-    def __init__(self, tracer=None, registry=None, flight=None):
+    def __init__(self, tracer=None, registry=None, flight=None,
+                 metrics_logger=None):
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.registry = (
             registry if registry is not None else MetricsRegistry()
         )
         self.flight = flight
+        self.metrics_logger = metrics_logger
 
     def phase(self, name: str) -> _Phase:
         return _Phase(self, name)
@@ -113,6 +121,7 @@ class NullObs:
     tracer = NULL_TRACER
     registry = NULL_REGISTRY
     flight = None
+    metrics_logger = None
 
     def phase(self, name: str):
         return NULL_SPAN

@@ -23,6 +23,11 @@ diagnosis instead of raw JSONL:
   admission control rejected most offered traffic — blamed on
   capacity, explicitly NOT on the queue) and canary-stuck rollouts
   (a ``rollout`` stream that ends on ``begin``/``canary``);
+* chaos fabric → ``chaos`` rows correlated with the self-healing
+  ``health`` causes: fault storm vs isolated recovery, with
+  ``quarantine_budget_exceeded`` (data corruption, not an input
+  stall) and unrevived ``replica_evicted`` blamed by name
+  (docs/ROBUSTNESS.md);
 * bench artifact → degraded-bench detection (``degraded: true``).
 
 Severity ranks ``crit`` > ``warn`` > ``info``; the CLI exits 0 only
@@ -67,6 +72,32 @@ STORE_THRASH_HIT_RATE = 0.5
 # absolute sheds keeps a 3-request toy window from reading as a storm.
 SHED_STORM_FRAC = 0.5
 SHED_STORM_MIN_TOTAL = 20
+
+# health causes owned by the self-healing fabric (xflow_tpu/chaos/,
+# docs/ROBUSTNESS.md): routed to _check_chaos for a named diagnosis —
+# _check_health must NOT read them as watchdog stall trips (a
+# quarantine abort is data corruption, not an input stall; an evicted
+# replica is a capacity event, not a queue bug).
+_SELF_HEAL_CAUSES = {
+    "record_quarantined",
+    "quarantine_budget_exceeded",
+    "checkpoint_fallback",
+    "checkpoint_save_failed",
+    "replica_evicted",
+    "replica_revived",
+    "replica_revive_failed",
+    "store_promote_restarted",
+    "store_promote_dead",
+}
+# the subset that means "the fault was absorbed and service recovered"
+_SELF_HEAL_RECOVERIES = {
+    "replica_revived",
+    "store_promote_restarted",
+    "checkpoint_fallback",
+}
+# fault storm: this many injected/absorbed faults in one stream stops
+# reading as "isolated recovery"
+CHAOS_STORM_MIN = 10
 
 _SEV_ORDER = {"crit": 0, "warn": 1, "info": 2}
 
@@ -160,6 +191,8 @@ def _check_health(rows: list[dict]) -> list[Diagnosis]:
         if r.get("kind") != "health":
             continue
         cause = r.get("cause", "?")
+        if cause in _SELF_HEAL_CAUSES:
+            continue  # _check_chaos owns the named diagnosis
         if cause.startswith("recovered:"):
             orig = cause.split(":", 1)[1]
             recovered[orig] = max(
@@ -401,6 +434,140 @@ def _check_serve(
     return out
 
 
+def _check_chaos(rows: list[dict]) -> list[Diagnosis]:
+    """Chaos-fabric forensics (xflow_tpu/chaos/, docs/ROBUSTNESS.md):
+    correlate ``chaos`` rows (injected faults) with the self-healing
+    ``health`` causes and rank what the run absorbed vs what stuck.
+
+    * **fault storm vs isolated recovery** — a handful of injected
+      faults all matched by recoveries is the chaos gate's healthy
+      shape (info); many faults, or faults without recoveries, rank as
+      a storm (warn).
+    * **quarantine_budget_exceeded** — named crit: the stream was
+      corrupt past the skip budget and the run aborted deliberately.
+      This is DATA corruption, not an input stall — without this
+      check its silence would misread as input_bound/input_stall.
+    * **replica_evicted** — evictions matched by revivals are absorbed
+      capacity events (info); unrevived evictions mean the fleet is
+      still running short (warn)."""
+    chaos_rows = [r for r in rows if r.get("kind") == "chaos"]
+    causes: dict[str, int] = {}
+    for r in rows:
+        if r.get("kind") == "health":
+            c = r.get("cause", "?")
+            causes[c] = causes.get(c, 0) + 1
+    out: list[Diagnosis] = []
+    if causes.get("quarantine_budget_exceeded"):
+        out.append(Diagnosis(
+            "crit",
+            "quarantine_budget_exceeded",
+            f"input corruption exceeded the quarantine budget "
+            f"({causes.get('record_quarantined', 0)} quarantined "
+            "block(s)/record(s) before the abort): the run stopped "
+            "deliberately rather than train on a corrupt stream — "
+            "this is data corruption, NOT an input stall; check the "
+            "shard files and the loader retry health rows "
+            "(docs/ROBUSTNESS.md)",
+        ))
+    quarantined = causes.get("record_quarantined", 0)
+    if quarantined and not causes.get("quarantine_budget_exceeded"):
+        out.append(Diagnosis(
+            "warn",
+            "record_quarantined",
+            f"{quarantined} block(s)/record(s) quarantined (under the "
+            "abort budget): input corruption is being skipped — those "
+            "samples never reached the model; check the shard files "
+            "and the loader retry health rows (docs/ROBUSTNESS.md)",
+        ))
+    fallbacks = causes.get("checkpoint_fallback", 0)
+    saves_failed = causes.get("checkpoint_save_failed", 0)
+    if fallbacks and not saves_failed:
+        out.append(Diagnosis(
+            "warn",
+            "checkpoint_fallback",
+            f"restore fell back past {fallbacks} unusable "
+            "generation(s) to an older complete one: training REWOUND "
+            "— deliberate under `--resume auto`, but inspect the "
+            "skipped generations (external corruption?) before the "
+            "keep-last-N GC ages the survivors out "
+            "(docs/ROBUSTNESS.md)",
+        ))
+    if saves_failed:
+        out.append(Diagnosis(
+            "warn",
+            "checkpoint_save_failed",
+            f"{saves_failed} checkpoint save(s) FAILED "
+            f"({causes.get('checkpoint_fallback', 0)} restore "
+            "fallback(s) seen): the run remains restorable from the "
+            "newest complete generation (`--resume auto`), but fix "
+            "the storage path before the retained generations age out "
+            "(docs/ROBUSTNESS.md)",
+        ))
+    evicted = causes.get("replica_evicted", 0)
+    revived = causes.get("replica_revived", 0)
+    if evicted:
+        if revived >= evicted and not causes.get("replica_revive_failed"):
+            out.append(Diagnosis(
+                "info",
+                "replica_evicted",
+                f"{evicted} replica eviction(s), all revived from the "
+                "shared artifact — scoring errors were absorbed as "
+                "capacity events (sheds during the gap are admission "
+                "control doing its job, not a queue bug)",
+            ))
+        else:
+            out.append(Diagnosis(
+                "warn",
+                "replica_evicted",
+                f"replica(s) evicted and NOT fully revived "
+                f"({evicted} evicted, {revived} revived, "
+                f"{causes.get('replica_revive_failed', 0)} revive "
+                "failure(s)) — the fleet is serving at reduced "
+                "capacity; expect sheds until replicas return "
+                "(docs/ROBUSTNESS.md)",
+            ))
+    if causes.get("store_promote_dead"):
+        out.append(Diagnosis(
+            "warn",
+            "store_promote_dead",
+            "the promotion worker died twice (one restart spent): "
+            "tier placement is frozen — training stays correct but "
+            "every new key rides the cold miss path; expect the hot "
+            "hit rate to decay (docs/ROBUSTNESS.md, docs/STORE.md)",
+        ))
+    if chaos_rows:
+        sites: dict[str, int] = {}
+        for r in chaos_rows:
+            s = r.get("site", "?")
+            sites[s] = sites.get(s, 0) + 1
+        n = len(chaos_rows)
+        recoveries = sum(causes.get(c, 0) for c in _SELF_HEAL_RECOVERIES)
+        recoveries += causes.get("recovered:io_retry", 0)
+        per_site = ", ".join(
+            f"{s}={c}" for s, c in sorted(sites.items())
+        )
+        unrecovered = any(d.severity in ("crit", "warn") for d in out)
+        if n >= CHAOS_STORM_MIN or unrecovered:
+            out.append(Diagnosis(
+                "warn",
+                "fault_storm",
+                f"fault storm: {n} injected fault(s) across "
+                f"{len(sites)} site(s) ({per_site}) with "
+                f"{recoveries} recovery row(s) — the findings above "
+                "name what did not heal",
+            ))
+        else:
+            out.append(Diagnosis(
+                "info",
+                "chaos_absorbed",
+                f"chaos fabric armed: {n} injected fault(s) "
+                f"({per_site}) absorbed by self-healing "
+                f"({recoveries} recovery row(s)) — isolated "
+                "recovery, not a storm",
+            ))
+    return out
+
+
 def _check_flight(flight: dict) -> list[Diagnosis]:
     reason = flight.get("reason", "?")
     phase = flight.get("active_phase", "")
@@ -463,6 +630,7 @@ def diagnose(
     """Every check, ranked most-severe-first (stable within rank)."""
     findings: list[Diagnosis] = []
     findings.extend(_check_health(rows))
+    findings.extend(_check_chaos(rows))
     findings.extend(_check_serve(
         rows,
         queue_stall_tripped=any(

@@ -205,6 +205,21 @@ SCHEMA: dict[str, dict[str, Any]] = {
         "canary_errors": int,
         "detail": str,
     },
+    # -- robustness (xflow_tpu/chaos/; docs/ROBUSTNESS.md) -----------------
+    # one per failpoint FIRE when the chaos fabric is armed
+    # (Config.chaos_spec / XFLOW_CHAOS): site is the failpoint name,
+    # hit the site's crossing count at fire time, fires the site's
+    # cumulative fire count.  scripts/check_chaos.py reconciles these
+    # rows against the registry's in-memory fire counts and demands a
+    # matching `health` row from the layer that healed each fault.
+    "chaos": {
+        "t": (int, float),
+        "kind": str,
+        "site": str,
+        "hit": int,
+        "fires": int,
+        "detail": str,
+    },
     # -- diagnosis (obs/watchdog.py, obs/flight.py; docs/OBSERVABILITY.md
     # "Diagnosing a sick run") ---------------------------------------------
     # one per watchdog incident transition: a trip (cause names the
@@ -274,6 +289,10 @@ OPTIONAL: dict[str, dict[str, Any]] = {
         "errors": int,
         "outstanding": int,
         "per_bucket": dict,
+        # 429s the HttpTarget retried after honoring Retry-After
+        # (capped exponential backoff) — chaos runs measure RECOVERY,
+        # not just rejection; rows from before the field predate it
+        "retried": int,
     },
 }
 

@@ -225,6 +225,12 @@ def cmd_serve(args) -> int:
     fleet.metrics_logger = logger
     flight.metrics_logger = logger
     fleet.log_load(args.artifact)
+    # chaos fabric (docs/ROBUSTNESS.md): the XFLOW_CHAOS env var arms
+    # the serve surface too, with chaos rows in this tier's stream
+    from xflow_tpu import chaos
+
+    if chaos.arm_from_env() is not None and logger is not None:
+        chaos.attach_logger(logger)
     tier = ServeTier(
         fleet,
         host=args.host,

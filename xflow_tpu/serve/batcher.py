@@ -35,6 +35,7 @@ from typing import Any
 
 import numpy as np
 
+from xflow_tpu.chaos import failpoint
 from xflow_tpu.obs.registry import MetricsRegistry, Snapshot
 
 _STOP = object()
@@ -334,6 +335,10 @@ class MicroBatcher:
             reg.observe("serve.queue_seconds", t_deq - t_enq)
         try:
             t0 = time.perf_counter()
+            # chaos site: a replica whose scoring raises — the batch's
+            # futures resolve with the error (below) and the fleet's
+            # eviction policy takes it out of routing (serve/fleet.py)
+            failpoint("serve.replica_score")
             batch = engine.featurize([row for row, _, _ in reqs])
             t1 = time.perf_counter()
             pctr = engine.predict_prepared(batch)[: len(reqs)]

@@ -38,9 +38,20 @@ stakes (arXiv:2201.05500):
   ``obs doctor`` flags a rollout that begins and never resolves
   (canary-stuck).
 
-Thread model (XF006–XF009 clean by construction): the fleet owns NO
-threads — replica MicroBatcher workers and the HTTP handler threads
-(serve/server.py) drive it.  All mutable fleet state (router counter,
+* **Replica health / self-healing** (docs/ROBUSTNESS.md).  A replica
+  whose scoring keeps raising (``evict_after_errors`` consecutive
+  errors — the ``serve.replica_score`` failpoint drives this in the
+  chaos gate) is EVICTED from routing with a ``replica_evicted``
+  health row; the shrunken fleet's backlog sheds at the door via
+  ``AdmissionPolicy`` (typed 429s, never a silent SLO bleed), and a
+  background revive thread re-clones the replica from the shared
+  artifact state and swaps it back (``replica_revived``).  With every
+  replica evicted, submits shed with cause ``replica_unavailable``.
+
+Thread model (XF006–XF009 clean by construction): the fleet owns no
+long-lived threads — replica MicroBatcher workers and the HTTP handler
+threads (serve/server.py) drive it; the short-lived revive threads are
+tracked in ``_revive_threads`` and joined (bounded) by ``close()``.  All mutable fleet state (router counter,
 rollout state, shed/error counters) lives under ``self._lock``; the
 lock is never held across a blocking call, a batcher submit, or an
 engine swap's digest check... with one deliberate exception: commit/
@@ -58,6 +69,7 @@ from concurrent.futures import Future
 from typing import Any, Sequence
 
 from xflow_tpu.obs.registry import Histogram, MetricsRegistry
+from xflow_tpu.obs.schema import health_row
 from xflow_tpu.serve.batcher import MicroBatcher, stats_row_from_snapshot
 
 
@@ -126,9 +138,13 @@ class ReplicaFleet:
         metrics_logger=None,
         flight=None,
         registry: MetricsRegistry | None = None,
+        evict_after_errors: int = 3,
+        revive: bool = True,
     ):
         if replicas < 1:
             raise ValueError("a fleet needs at least 1 replica")
+        if evict_after_errors < 1:
+            raise ValueError("evict_after_errors must be >= 1")
         self.policy = AdmissionPolicy(deadline_budget_ms, depth_budget)
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics_logger = metrics_logger
@@ -159,6 +175,19 @@ class ReplicaFleet:
         self._completed = 0
         self._errors = 0
         self._shed: dict[str, int] = {}
+        # replica health (docs/ROBUSTNESS.md): a replica whose scoring
+        # keeps raising is EVICTED from routing (capacity shrinks, so
+        # AdmissionPolicy sheds the overflow at the door) and a
+        # background revive thread re-clones it from the shared
+        # artifact state.  All of it under self._lock; revive threads
+        # are tracked and joined (bounded) by close() — XF006.
+        self.evict_after_errors = evict_after_errors
+        self._revive_enabled = revive
+        self._err_streak = [0] * replicas
+        self._unhealthy: set[int] = set()
+        self._revive_threads: list[threading.Thread] = []
+        self._evictions = 0
+        self._revivals = 0
         self._rollout: dict[str, Any] | None = None
         # serializes rollout-row emission (terminal rows vs the stats
         # window's canary heartbeat) WITHOUT holding the fleet lock
@@ -212,7 +241,8 @@ class ReplicaFleet:
         digest, so it cannot exist before ``load`` returns)."""
         if self.metrics_logger is None:
             return
-        e = self.engines[0]
+        with self._lock:  # engines[] mutates under rollout/revive
+            e = self.engines[0]
         self.metrics_logger.log("serve_load", {
             "artifact": artifact,
             "config_digest": e.digest,
@@ -224,7 +254,9 @@ class ReplicaFleet:
 
     @property
     def cfg(self):
-        return self.engines[0].cfg
+        with self._lock:  # engines[] mutates under rollout/revive
+            e = self.engines[0]
+        return e.cfg
 
     @property
     def replicas(self) -> int:
@@ -249,21 +281,36 @@ class ReplicaFleet:
             if self._closed:
                 raise RuntimeError("ReplicaFleet is closed")
             self._seq += 1
+            healthy = [
+                i for i in range(len(self.batchers))
+                if i not in self._unhealthy
+            ]
+            if not healthy:
+                # every replica is evicted: shed at the door with its
+                # own typed cause — capacity is gone, not queued away
+                self._shed["replica_unavailable"] = (
+                    self._shed.get("replica_unavailable", 0) + 1
+                )
+                raise ShedError(
+                    "replica_unavailable", 0, 0.0,
+                    "all replicas evicted (revive pending)",
+                )
             ro = self._rollout
             if ro is not None:
-                ro["acc"] += ro["canary_frac"]
-                if ro["acc"] >= 1.0:
-                    ro["acc"] -= 1.0
-                    return ro["canary"], ro
-                others = [
-                    i for i in range(len(self.batchers))
-                    if i != ro["canary"]
-                ]
-                if not others:  # single-replica fleet: all canary
+                # an evicted canary falls through to the healthy rest:
+                # the rollout gate simply stops accumulating until the
+                # revive lands (health rows make the overlap visible)
+                if ro["canary"] in healthy:
+                    ro["acc"] += ro["canary_frac"]
+                    if ro["acc"] >= 1.0:
+                        ro["acc"] -= 1.0
+                        return ro["canary"], ro
+                others = [i for i in healthy if i != ro["canary"]]
+                if not others:  # single healthy replica: all canary
                     return ro["canary"], ro
                 self._rr += 1
                 return others[self._rr % len(others)], None
-            return self._seq % len(self.batchers), None
+            return healthy[self._seq % len(healthy)], None
 
     def submit(self, keys, slots=None, vals=None) -> Future:
         """Admission-checked enqueue onto one replica; returns the
@@ -288,7 +335,7 @@ class ReplicaFleet:
         with self._lock:
             self._admitted += 1
         fut.add_done_callback(
-            lambda f, t0=t0, ro=ro_token: self._done(f, t0, ro)
+            lambda f, t0=t0, ro=ro_token, i=idx: self._done(f, t0, ro, i)
         )
         return fut
 
@@ -297,18 +344,33 @@ class ReplicaFleet:
         return float(self.submit(keys, slots, vals).result(timeout))
 
     def _done(self, fut: Future, t0: float,
-              ro_token: dict | None) -> None:
+              ro_token: dict | None, idx: int) -> None:
         """Completion bookkeeping (runs on the resolving replica's
         worker thread — worker context, so everything under the fleet
         lock).  Canary health only counts completions whose routing
         token IS the still-open rollout: a straggler from a resolved
-        rollout must not feed the gate of the one that replaced it."""
+        rollout must not feed the gate of the one that replaced it.
+        Scoring errors feed the replica-health streak: at
+        ``evict_after_errors`` consecutive errors the replica is
+        evicted from routing and a background revive re-clones it."""
         err = fut.exception() is not None
         dt = time.perf_counter() - t0
+        evict = False
         with self._lock:
             self._completed += 1
             if err:
                 self._errors += 1
+                self._err_streak[idx] += 1
+                if (
+                    self._err_streak[idx] >= self.evict_after_errors
+                    and idx not in self._unhealthy
+                    and not self._closed
+                ):
+                    self._unhealthy.add(idx)
+                    self._evictions += 1
+                    evict = True
+            else:
+                self._err_streak[idx] = 0
             ro = self._rollout
             if ro_token is not None and ro is ro_token:
                 ro["requests"] += 1
@@ -319,6 +381,112 @@ class ReplicaFleet:
                     # fast-failing or timed-out request must not skew
                     # the p99 gate's success-latency population
                     ro["latency"].observe(dt)
+        if evict:
+            self._evict(idx)
+
+    # -- replica health (eviction / revive) ---------------------------------
+
+    def _evict(self, idx: int) -> None:
+        """One replica just crossed the error streak: it is already out
+        of routing (``_unhealthy``, set by _done under the lock);
+        here — outside the lock — comes the loud part (health row,
+        counter) and the background revive.  Shrunk capacity is real:
+        the survivors' queues grow and AdmissionPolicy sheds the
+        overflow at the door, which is the design (never queue past
+        the deadline budget on a sick fleet)."""
+        self.registry.counter_add("serve.replica_evicted")
+        if self.metrics_logger is not None:
+            self.metrics_logger.log("health", health_row(
+                cause="replica_evicted",
+                channel="serve",
+                silence_seconds=0.0,
+                threshold_seconds=0.0,
+                detail=f"replica {idx}: {self.evict_after_errors} "
+                "consecutive scoring error(s) — evicted from routing",
+            ))
+        if not self._revive_enabled:
+            return
+        # not fire-and-forget: tracked in _revive_threads and joined
+        # (bounded) by close()
+        t = threading.Thread(  # xf: ignore[XF006]
+            target=self._revive,
+            args=(idx,),
+            name=f"xflow-replica-revive-{idx}",
+            daemon=True,
+        )
+        with self._lock:
+            # prune finished revives so a flapping replica can't grow
+            # the list for the process lifetime
+            self._revive_threads = [
+                rt for rt in self._revive_threads if rt.is_alive()
+            ]
+            self._revive_threads.append(t)
+        t.start()
+
+    def _revive(self, idx: int) -> None:
+        """Background revive: re-clone the replica from the shared
+        artifact state (PredictEngine.clone — shared weights + AOT
+        executables, fresh host-side staging) and swap it back into
+        routing.  A failed revive leaves the replica evicted (capacity
+        stays shed) with its own health row — never a silent retry
+        loop."""
+        try:
+            for _ in range(8):  # bounded: rollouts can't starve this
+                with self._lock:
+                    src = self.engines[idx]
+                clone = src.clone()  # outside the lock: not free
+                # re-verify under the lock before installing (the
+                # commit_rollout discipline): a rollout that committed
+                # while we cloned has already swapped engines[idx] —
+                # force-installing our pre-commit clone would silently
+                # revert this one replica to the old artifact, the
+                # exact mixed-fleet state rollouts exist to prevent.
+                # Lock order fleet._lock -> batcher._swap_lock matches
+                # commit/abort.
+                with self._lock:
+                    if self.engines[idx] is not src:
+                        continue  # re-clone from the new incumbent
+                    self.batchers[idx].swap(clone, force=True)
+                    self.engines[idx] = clone
+                    self._unhealthy.discard(idx)
+                    self._err_streak[idx] = 0
+                    self._revivals += 1
+                    break
+            else:
+                raise RuntimeError(
+                    "engine kept changing under the revive (8 "
+                    "rollout swaps mid-clone)"
+                )
+            self.registry.counter_add("serve.replica_revived")
+            if self.metrics_logger is not None:
+                self.metrics_logger.log("health", health_row(
+                    cause="replica_revived",
+                    channel="serve",
+                    silence_seconds=0.0,
+                    threshold_seconds=0.0,
+                    detail=f"replica {idx}: re-cloned from the shared "
+                    "artifact and returned to routing",
+                ))
+        except Exception as e:
+            if self.metrics_logger is not None:
+                self.metrics_logger.log("health", health_row(
+                    cause="replica_revive_failed",
+                    channel="serve",
+                    silence_seconds=0.0,
+                    threshold_seconds=0.0,
+                    detail=f"replica {idx}: {type(e).__name__}: {e} — "
+                    "left evicted, fleet serving at reduced capacity",
+                ))
+
+    def health(self) -> dict:
+        """Live replica-health snapshot (the /v1/stats and chaos-gate
+        surface)."""
+        with self._lock:
+            return {
+                "unhealthy": sorted(self._unhealthy),
+                "evictions": self._evictions,
+                "revivals": self._revivals,
+            }
 
     def pending(self) -> bool:
         """Any replica has queued or in-flight work — the watchdog's
@@ -343,7 +511,8 @@ class ReplicaFleet:
         # engine it was built around instead of silently loading the
         # defaults (1-device mesh, default buckets → recompiles and
         # latency shifts with no error)
-        inc = self.engines[0]
+        with self._lock:  # engines[] mutates under rollout/revive
+            inc = self.engines[0]
         kw = self._load_kw or {
             "num_devices": int(inc.mesh.devices.size),
             "buckets": list(inc.buckets),
@@ -664,6 +833,7 @@ class ReplicaFleet:
         snap = self.registry.snapshot(reset=False)
         with self._lock:
             shed = self._shed_row_locked()
+            engine0 = self.engines[0]
         return {
             "digest": self.digest,
             "replicas": self.replicas,
@@ -672,7 +842,8 @@ class ReplicaFleet:
             "depth": self.depth(),
             "queue_age_s": round(self.queue_age_s(), 6),
             "rollout": self.rollout_state(),
-            "compiles": self.engines[0].compile_count,
+            "health": self.health(),
+            "compiles": engine0.compile_count,
         }
 
     def close(self) -> dict:
@@ -687,6 +858,26 @@ class ReplicaFleet:
             try:
                 for b in self.batchers:
                     b.close()
+                # revive threads joined (bounded) before the final
+                # window: a revive racing shutdown must not swap into
+                # a closed fleet unobserved (XF006 — no thread outlives
+                # close silently)
+                with self._lock:
+                    revives = list(self._revive_threads)
+                for t in revives:
+                    t.join(timeout=10.0)
+                    if t.is_alive():
+                        import warnings
+
+                        warnings.warn(
+                            f"replica revive thread {t.name} outlived "
+                            "the close() join",
+                            RuntimeWarning,
+                            stacklevel=2,
+                        )
+                        self.registry.counter_add(
+                            "serve.revive_thread_leak"
+                        )
                 final = self.emit_stats()
                 with self._lock:
                     self._final_rows = final

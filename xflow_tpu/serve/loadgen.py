@@ -71,15 +71,30 @@ def zipf_rows(
 class HttpTarget:
     """Adapter giving an HTTP serving tier the fleet ``submit``
     protocol: synchronous single-row POST per call (the worker thread
-    IS the connection), resolved-Future return, 429 → ShedError.
+    IS the connection), resolved-Future return, 429 → backoff-retry →
+    ShedError.
 
     Each worker thread keeps ONE persistent HTTP/1.1 connection
     (thread-local, reconnect-once on a server-closed keep-alive
     socket): a per-request TCP handshake would inflate the client
     e2e percentiles that ``check_serve_slo.py`` gates on with a cost
-    the tier never incurred."""
+    the tier never incurred.
 
-    def __init__(self, url: str, timeout_s: float = 30.0):
+    Typed 429s are honored, not just booked: the server's retry
+    advice (the typed body's ``retry_after_ms``, falling back to the
+    coarser ``Retry-After`` header) seeds a capped exponential backoff
+    and the request is re-offered up to ``max_retries`` times before
+    it counts as a shed — so chaos runs measure RECOVERY, not just
+    rejection.  Retries are counted in ``self.retried`` and land in
+    the ``serve_bench`` row."""
+
+    def __init__(
+        self,
+        url: str,
+        timeout_s: float = 30.0,
+        max_retries: int = 2,
+        backoff_cap_s: float = 1.0,
+    ):
         from urllib.parse import urlsplit
 
         self.url = url.rstrip("/")
@@ -92,9 +107,14 @@ class HttpTarget:
         self._port = parts.port or 80
         self._path = parts.path.rstrip("/")
         self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.backoff_cap_s = backoff_cap_s
         self._local = threading.local()
+        self._retry_lock = threading.Lock()
+        self.retried = 0
 
-    def _post(self, path: str, body: bytes) -> tuple[int, bytes]:
+    def _post(self, path: str, body: bytes) -> tuple[int, bytes, str]:
+        """(status, payload, Retry-After header or "")."""
         import http.client
 
         conn = getattr(self._local, "conn", None)
@@ -111,7 +131,9 @@ class HttpTarget:
                     headers={"Content-Type": "application/octet-stream"},
                 )
                 r = conn.getresponse()
-                return r.status, r.read()
+                return (
+                    r.status, r.read(), r.getheader("Retry-After") or ""
+                )
             except ConnectionError:
                 # the server may close an idle keep-alive socket
                 # between arrivals (RemoteDisconnected subclasses
@@ -133,6 +155,23 @@ class HttpTarget:
                 raise
         raise AssertionError("unreachable")
 
+    def _retry_delay_s(self, retry_after: str, doc: dict,
+                       attempt: int) -> float:
+        """Backoff seed, most-precise source first: the typed body's
+        ``retry_after_ms`` (our tier's millisecond advice), then the
+        Retry-After header (HTTP-spec integer seconds — the tier
+        floors it at 1s, so preferring it would park every retry a
+        full second), then 50ms — doubled per attempt, capped."""
+        base = 0.05
+        if "retry_after_ms" in doc:
+            base = max(float(doc["retry_after_ms"]) / 1000.0, 0.001)
+        elif retry_after:
+            try:
+                base = max(float(retry_after), 0.001)
+            except ValueError:
+                pass  # HTTP-date form / garbage: keep the fallback
+        return min(base * 2.0**attempt, self.backoff_cap_s)
+
     def submit(self, keys, slots=None, vals=None) -> Future:
         import json
 
@@ -142,25 +181,32 @@ class HttpTarget:
         )
 
         fut: Future = Future()
-        try:
-            status, payload = self._post(
-                "/v1/score_packed",
-                encode_packed_request([(keys, slots, vals)]),
-            )
-        except Exception as e:  # connection errors → failed request
-            fut.set_exception(e)
-            return fut
-        if status == 429:
+        body = encode_packed_request([(keys, slots, vals)])
+        for attempt in range(self.max_retries + 1):
+            try:
+                status, payload, retry_after = self._post(
+                    "/v1/score_packed", body
+                )
+            except Exception as e:  # connection errors → failed request
+                fut.set_exception(e)
+                return fut
+            if status != 429:
+                break
             try:
                 doc = json.loads(payload.decode() or "{}")
             except ValueError:
                 doc = {}  # a proxy's bare 429 is still a shed
-            raise ShedError(
-                doc.get("cause", "unknown"),
-                int(doc.get("depth", 0)),
-                float(doc.get("queue_age_ms", 0.0)) / 1000.0,
-                "remote",
-            )
+            if attempt == self.max_retries:
+                # retries exhausted: NOW it is a shed
+                raise ShedError(
+                    doc.get("cause", "unknown"),
+                    int(doc.get("depth", 0)),
+                    float(doc.get("queue_age_ms", 0.0)) / 1000.0,
+                    "remote",
+                )
+            with self._retry_lock:
+                self.retried += 1
+            time.sleep(self._retry_delay_s(retry_after, doc, attempt))
         if status != 200:
             fut.set_exception(RuntimeError(
                 f"HTTP {status}: {payload[:200]!r}"
@@ -289,8 +335,11 @@ def run_loadgen(
                 rng, len(idxs),
                 table_size=table_size, nnz=nnz, zipf_a=zipf_a,
             )
-        except Exception:
-            pass  # booked below, after the barrier
+        except Exception:  # xf: ignore[XF015]
+            # NOT a silent swallow: rows stays None and every arrival
+            # of this stripe is booked as a failed request after the
+            # barrier (the loud path lives below)
+            pass
         try:
             gen_barrier.wait(timeout=60.0)
         except threading.BrokenBarrierError:
@@ -382,6 +431,9 @@ def run_loadgen(
         "shed_by_cause": snap["shed"],
         "errors": snap["errors"] + leaked,
         "outstanding": rec.outstanding(),
+        # 429s the target transparently retried (HttpTarget honoring
+        # Retry-After; in-process fleets never retry — 0)
+        "retried": int(getattr(target, "retried", 0)),
     }
     if hasattr(target, "emit_stats"):
         rows = target.emit_stats()  # serve_stats + serve_shed flushed

@@ -57,6 +57,7 @@ import numpy as np
 
 from concurrent.futures import TimeoutError as FutureTimeout
 
+from xflow_tpu.chaos import ChaosError, failpoint
 from xflow_tpu.serve.fleet import ReplicaFleet, RolloutError, ShedError
 
 PACKED_MAGIC = b"XFS1"
@@ -157,6 +158,16 @@ class _TierServer(ThreadingHTTPServer):
         # watchdog's `http` channel — silence here means the front
         # door is wedged, regardless of how the scoring path feels
         tier = self.tier
+        try:
+            # chaos site: a transient accept-loop/socket-layer error.
+            # The loop SURVIVES it (the chaos row is already logged by
+            # the registry; handler sockets are untouched) — an accept
+            # loop that dies on one bad poll is a total outage, which
+            # is exactly what the watchdog's serve_accept_stall exists
+            # to catch if this discipline ever regresses.
+            failpoint("serve.accept")
+        except ChaosError:
+            tier.accept_faults += 1
         if tier.flight is not None:
             tier.flight.note_http("accept")
         # auto rollouts advance here so they progress with no admin
@@ -398,6 +409,9 @@ class ServeTier:
         self.fleet = fleet
         self.flight = flight
         self.default_canary_frac = default_canary_frac
+        # survived serve.accept failpoint fires (written only from the
+        # accept loop, read by tests/the chaos gate after close)
+        self.accept_faults = 0
         self._poll_s = poll_s
         self._drain_timeout_s = drain_timeout_s
         self._httpd = _TierServer((host, port), _Handler)

@@ -29,6 +29,7 @@ import threading
 
 import numpy as np
 
+from xflow_tpu.chaos import failpoint
 from xflow_tpu.obs import NULL_OBS
 
 POLL_S = 0.05
@@ -56,6 +57,11 @@ class PromotionWorker:
         self._plan_q: queue.Queue = queue.Queue(maxsize=2)
         self._ack_q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
+        # set when _run dies on an exception (the store.promote_worker
+        # failpoint, or a real bug): TieredStore.maintain polls
+        # alive() every step and restarts the worker ONCE with a
+        # health row — placement degrades, correctness never does
+        self.crashed: BaseException | None = None
         self._thread = threading.Thread(
             target=self._run, name="store-promote", daemon=True
         )
@@ -109,6 +115,15 @@ class PromotionWorker:
                 ))
         return not leaked
 
+    def alive(self) -> bool:
+        """The worker thread is still running.  False + ``crashed``
+        set = it died on an exception; False + clean = it exited via
+        close().  maintain() (store/tiered.py) polls this between
+        steps — the watchdog's ``store`` channel independently sees
+        the silence, but the restart decision is taken on the strictly
+        sequential maintain path so it can never race a live plan."""
+        return self._thread.is_alive()
+
     # -- worker -------------------------------------------------------------
 
     def _beat(self, detail: str) -> None:
@@ -117,6 +132,17 @@ class PromotionWorker:
             flight.note_store(detail)
 
     def _run(self) -> None:
+        try:
+            self._run_inner()
+        except BaseException as e:
+            # worker death is a FACT to surface, not a crash to spread:
+            # record it (maintain's alive() poll restarts once + emits
+            # the health row) and exit — the store keeps training
+            # correctly with placement frozen (all-miss for new keys)
+            self.crashed = e
+            self._obs.counter("store.promote_crash")
+
+    def _run_inner(self) -> None:
         scores: dict[int, float] = {}
         hot_view: set[int] = set()
         scores_max = max(SCORES_MAX_FACTOR * self.capacity, 65536)
@@ -134,6 +160,10 @@ class PromotionWorker:
             except queue.Empty:
                 self._beat("idle")
                 continue
+            # chaos site: a fire kills THIS thread (caught by _run's
+            # death recorder) — the self-healing under test is the
+            # maintain()-side detect-and-restart-once
+            failpoint("store.promote_worker")
             self._beat("note")
             notes += 1
             miss_keys: list[int] = []

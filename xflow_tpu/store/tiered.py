@@ -41,6 +41,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from xflow_tpu.chaos import ChaosError, emit_health, failpoint, retry_call
 from xflow_tpu.config import Config
 from xflow_tpu.io.compact import dedup_select, plane_cap
 from xflow_tpu.obs import NULL_OBS
@@ -96,6 +97,10 @@ class TieredStore:
             },
             seed=cfg.seed,
         )
+        # default health/counter sink for paths with no per-call obs
+        # (checkpoint/export/close flushes) — Trainer rebinds it to the
+        # live bundle so heals there are as loud as maintain()'s
+        self.obs = NULL_OBS
         self.promoter: PromotionWorker | None = None
         # staged plans keyed by the IDENTITY of the device-array dict
         # they were built with (put_batch returns it; dispatch passes
@@ -106,6 +111,12 @@ class TieredStore:
         # stay alive
         self._staged: deque = deque(maxlen=2)
         self._pending: tuple[BatchPlan, dict] | None = None
+        # promotion-worker self-healing (docs/ROBUSTNESS.md): a dead
+        # worker is restarted exactly ONCE; a second death leaves the
+        # store running with placement frozen (new keys stay all-miss
+        # — correct, just cold) rather than thrashing restarts
+        self._promoter_restarts = 0
+        self._promoter_dead = False
 
     # -- per-batch planning -------------------------------------------------
 
@@ -153,8 +164,22 @@ class TieredStore:
             refs[mask] = ref_of_u[codes]
         refs2d = refs.reshape(b, k).astype(np.int32)
         t0 = time.perf_counter()
-        fetched = self.cold.fetch(
-            miss_keys, planes=("param",) if param_only else None
+
+        def fetch():
+            # chaos site: transient cold-store read — bounded retry
+            # heals it with zero data loss (the fetch is idempotent)
+            failpoint("store.cold_fetch")
+            return self.cold.fetch(
+                miss_keys, planes=("param",) if param_only else None
+            )
+
+        fetched = retry_call(
+            fetch,
+            attempts=self.cfg.io_retries,
+            backoff_s=self.cfg.io_retry_backoff_s,
+            channel="store",
+            site="cold_fetch",
+            obs=obs,
         )
         obs.counter(
             "store.cold_fetch_seconds", time.perf_counter() - t0
@@ -226,11 +251,16 @@ class TieredStore:
         self.complete_pending()  # invariant: at most one pending
         self._pending = (plan, miss_out)
 
-    def complete_pending(self) -> None:
+    def complete_pending(self, obs=None) -> None:
         """Flush the deferred write-back: fetch the step's updated miss
         rows and upsert them into the cold store.  Called before every
         plan (read-your-writes), before maintenance, checkpoint save,
-        export, and close."""
+        export, and close.  The upsert is idempotent, so a transient
+        failure (``store.writeback`` failpoint) retries safely —
+        loudly on every call path (no-obs callers fall back to the
+        store's own bundle)."""
+        if obs is None:
+            obs = self.obs
         if self._pending is None:
             return
         plan, miss_out = self._pending
@@ -239,22 +269,38 @@ class TieredStore:
         if not n:
             return
         host = jax.device_get(miss_out)
-        self.cold.write(plan.miss_keys, {
-            tname: {
-                aname: np.asarray(block)[:n]
-                for aname, block in arrs.items()
-            }
-            for tname, arrs in host.items()
-        })
+
+        def write():
+            failpoint("store.writeback")
+            self.cold.write(plan.miss_keys, {
+                tname: {
+                    aname: np.asarray(block)[:n]
+                    for aname, block in arrs.items()
+                }
+                for tname, arrs in host.items()
+            })
+
+        retry_call(
+            write,
+            attempts=self.cfg.io_retries,
+            backoff_s=self.cfg.io_retry_backoff_s,
+            channel="store",
+            site="writeback",
+            obs=obs,
+        )
 
     # -- tier maintenance ---------------------------------------------------
 
     def maintain(self, state: dict, obs=NULL_OBS) -> dict:
-        """Between-steps application point: flush the write-back, then
-        apply the promotion worker's plan (if any).  Returns the
-        (possibly rebound) device state."""
-        self.complete_pending()
+        """Between-steps application point: flush the write-back, check
+        the promotion worker's pulse, then apply its plan (if any).
+        Returns the (possibly rebound) device state."""
+        self.complete_pending(obs=obs)
         if self.promoter is None:
+            return state
+        if not self.promoter.alive() and not self._promoter_dead:
+            self._heal_promoter(obs)
+        if self._promoter_dead:
             return state
         plan = self.promoter.poll_plan()
         if plan is None:
@@ -278,6 +324,39 @@ class TieredStore:
             obs.counter("store.demotions", len(demoted))
             self.promoter.ack(promoted, demoted)
         return state
+
+    def _heal_promoter(self, obs) -> None:
+        """The promotion worker died (the watchdog's ``store`` channel
+        sees the silence; this is the sequential-path restart point).
+        Restart ONCE — the fresh worker's empty hot_view self-corrects
+        through maintain's slot_of filters + acks.  A second death
+        leaves placement frozen: the store stays correct (hot hits
+        keep hitting, new keys ride the miss path) with no more tier
+        movement — degraded, loud, never corrupt."""
+        crash = self.promoter.crashed
+        self.promoter.close()  # dead thread: the join returns at once
+        if self._promoter_restarts == 0:
+            self._promoter_restarts += 1
+            obs.counter("store.promote_restarts")
+            emit_health(
+                obs,
+                cause="store_promote_restarted",
+                channel="store",
+                detail=f"promotion worker died "
+                f"({type(crash).__name__ if crash else 'no exception'}"
+                f"{f': {crash}' if crash else ''}) — restarted once",
+            )
+            self.promoter = PromotionWorker(self.hot.capacity, obs=obs)
+        else:
+            self._promoter_dead = True
+            emit_health(
+                obs,
+                cause="store_promote_dead",
+                channel="store",
+                detail="promotion worker died again after its one "
+                "restart — tier placement frozen (all-miss for new "
+                "keys); training continues correctly",
+            )
 
     def _pad_slots(self, slots: np.ndarray) -> jax.Array:
         out = np.full(PROMOTE_CAP, self.hot.capacity, np.int32)
@@ -437,6 +516,10 @@ class TieredStore:
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
+        # same chaos site as the dense path: a fire leaves only a
+        # .tmp-ckpt-* (cleaned by the next save); the previous
+        # committed generation stays the newest complete one
+        failpoint("ckpt.write_shard")
         host = jax.device_get(state["tables"])
         occupied = np.flatnonzero(self.hot.key_of >= 0)
         hkeys = self.hot.key_of[occupied]
@@ -488,6 +571,7 @@ class TieredStore:
         }
         with open(os.path.join(tmp, MANIFEST), "w") as f:
             json.dump(manifest, f, indent=2)
+        failpoint("ckpt.finalize")  # kill mid-commit (manifest-last)
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)
@@ -500,6 +584,14 @@ class TieredStore:
         """Restore: repopulate the cold store with the folded rows,
         reset the hot tier (promotion re-warms it), rebuild device
         state.  Returns (state, cursor)."""
+        from xflow_tpu.utils.checkpoint import is_complete
+
+        failpoint("ckpt.restore")
+        if not is_complete(path):
+            raise IncompatibleCheckpoint(
+                f"checkpoint {path} has no {MANIFEST} — incomplete or "
+                "externally corrupted generation"
+            )
         with open(os.path.join(path, MANIFEST)) as f:
             manifest = json.load(f)
         store_meta = manifest.get("store")
@@ -551,6 +643,8 @@ class TieredStore:
             # the maps it mirrors
             self.promoter.close()
             self.promoter = None
+        self._promoter_restarts = 0  # restored run: fresh heal budget
+        self._promoter_dead = False
         self.cold.load_rows(keys, data)
         self.hot.reset_maps()
         new_state = self.init_device_state()

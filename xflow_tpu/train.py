@@ -203,7 +203,33 @@ def build_parser() -> argparse.ArgumentParser:
         "at the end) — the reference evaluates once, after all epochs "
         "(lr_worker.cc:212-215)",
     )
-    p.add_argument("--resume", action="store_true", help="resume from latest checkpoint")
+    p.add_argument(
+        "--resume", nargs="?", const="latest", default=None,
+        choices=["latest", "auto"],
+        help="resume from a checkpoint: bare --resume (or 'latest') "
+        "follows the LATEST marker; 'auto' restores the newest "
+        "COMPLETE generation, skipping half-written or corrupted ones "
+        "with a health row (docs/ROBUSTNESS.md) — the flag to reach "
+        "for after a kill/preemption mid-checkpoint",
+    )
+    p.add_argument(
+        "--chaos-spec", dest="chaos_spec",
+        help="arm the seeded failpoint fabric, e.g. "
+        "'seed=7;loader.read_block:nth=2' (docs/ROBUSTNESS.md; the "
+        "XFLOW_CHAOS env var arms the same machinery)",
+    )
+    p.add_argument(
+        "--io-retries", type=int, dest="io_retries",
+        help="transient shard-read/parse and cold-store retry budget "
+        "per block (exponential backoff; exhausted retries quarantine "
+        "the block)",
+    )
+    p.add_argument(
+        "--max-quarantined-frac", type=float, dest="max_quarantined_frac",
+        help="abort the stream once quarantined blocks exceed "
+        "max(1, ceil(frac * blocks seen)) — skip-and-continue is for "
+        "isolated corruption, not a rotten stream",
+    )
     p.add_argument(
         "--export-artifact", dest="export_artifact",
         help="after training, freeze the model into a serving artifact "
@@ -269,7 +295,7 @@ def main(argv: list[str] | None = None) -> int:
     # train()'s own preemption/crash paths)
     with Trainer(cfg) as trainer:
         if args.resume:
-            cursor = trainer.restore()
+            cursor = trainer.restore(auto=(args.resume == "auto"))
             if cursor:
                 print(f"resumed at {cursor}", file=sys.stderr)
         history = trainer.train()

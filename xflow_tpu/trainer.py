@@ -124,6 +124,10 @@ class Trainer:
                 step_fn=lambda: self._global_steps,
             )
         self.step.obs = self.obs
+        if self.step.store is not None:
+            # checkpoint/export/close-path store heals report through
+            # the live bundle (store/tiered.py complete_pending)
+            self.step.store.obs = self.obs
         self.metrics_logger = None
         if cfg.metrics_out:
             from xflow_tpu.utils.logging import MetricsLogger
@@ -137,6 +141,27 @@ class Trainer:
             self.metrics_logger = MetricsLogger(
                 path, run_header=self._run_header()
             )
+            # the self-healing fabric's health-row sink (chaos/heal.py):
+            # retries/quarantines/restarts are loud whenever a metrics
+            # stream exists, flight recorder or not
+            if self.obs.enabled:
+                self.obs.metrics_logger = self.metrics_logger
+        # Chaos fabric (xflow_tpu/chaos/; docs/ROBUSTNESS.md): arm the
+        # failpoint registry from the config spec / env var, and route
+        # its `chaos` audit rows into this run's metrics stream.
+        from xflow_tpu import chaos
+
+        # a config-armed schedule's lifetime is THIS trainer's: close()
+        # disarms it, so a later non-chaos Trainer in the same process
+        # never inherits the fault schedule.  Env-var arming is
+        # process-level intent and stays.
+        self._armed_chaos = bool(cfg.chaos_spec)
+        if cfg.chaos_spec:
+            chaos.arm(cfg.chaos_spec)
+        else:
+            chaos.arm_from_env()
+        if chaos.armed() is not None and self.metrics_logger is not None:
+            chaos.attach_logger(self.metrics_logger)
         # Flight recorder + stall watchdog (obs/flight.py, watchdog.py):
         # the recorder rides the live Obs so ShardLoader/PredictEngine
         # heartbeat it; the watchdog monitor starts now and stops in
@@ -305,8 +330,17 @@ class Trainer:
                 path = f"{path}.exit"
             self._flight.dump(path, reason, exc=exc)
         self._export_trace()
+        from xflow_tpu import chaos
+
         if self.metrics_logger is not None:
+            # an armed registry must not keep logging through a closed
+            # logger (detach is a no-op for anyone else's logger)
+            chaos.detach_logger(self.metrics_logger)
             self.metrics_logger.close()
+        if self._armed_chaos:
+            # the schedule this trainer armed from its config dies with
+            # it (idempotent; env-armed registries are left alone)
+            chaos.disarm()
 
     def _export_trace(self) -> None:
         if not (self.cfg.obs_trace_out and self.obs.tracer.enabled):
@@ -408,6 +442,9 @@ class Trainer:
             # v2 packed shards skip expansion AND re-compaction when
             # the step consumes the dict wire (io/compact.py)
             emit_compact=self.step.dict_wire,
+            io_retries=cfg.io_retries,
+            io_retry_backoff_s=cfg.io_retry_backoff_s,
+            max_quarantined_frac=cfg.max_quarantined_frac,
         )
 
     def _tracked_prefetch(self, loader: ShardLoader, depth, offset, workers):
@@ -623,10 +660,27 @@ class Trainer:
     def _timed_save(self, shard_idx: int, offset: int) -> float:
         """save() booked as the 'checkpoint' phase; returns the seconds
         so train_epoch reports checkpoint_seconds separately instead of
-        letting saves silently deflate examples_per_sec."""
+        letting saves silently deflate examples_per_sec.  A FAILED save
+        (I/O error, ckpt.* failpoint) leaves a ``health`` row before
+        re-raising — the crash-atomic protocol guarantees the previous
+        complete generation survives for ``--resume auto``."""
         t0 = time.perf_counter()
-        with self.obs.phase("checkpoint"):
-            self.save(shard_idx, offset)
+        try:
+            with self.obs.phase("checkpoint"):
+                self.save(shard_idx, offset)
+        except BaseException as e:
+            if self.metrics_logger is not None:
+                from xflow_tpu.obs.schema import health_row
+
+                self.metrics_logger.log("health", health_row(
+                    cause="checkpoint_save_failed",
+                    channel="train",
+                    silence_seconds=0.0,
+                    threshold_seconds=0.0,
+                    detail=f"{type(e).__name__}: {e} — previous "
+                    "complete generation remains restorable",
+                ))
+            raise
         return time.perf_counter() - t0
 
     def train_epoch(self, start_shard: int = 0, start_offset: int = 0) -> dict:
@@ -889,7 +943,10 @@ class Trainer:
                     break
                 self.epoch += 1
                 if self.cfg.checkpoint_dir:
-                    self.save(0, 0)
+                    # _timed_save: a failed epoch-end save emits its
+                    # checkpoint_save_failed health row before the
+                    # crash path takes over
+                    self._timed_save(0, 0)
                 if (
                     self.cfg.eval_every_epochs
                     and self.cfg.test_path
@@ -1175,29 +1232,57 @@ class Trainer:
         self._pulse("idle")
         return path
 
-    def restore(self) -> dict | None:
-        """Resume from the latest checkpoint if one exists; returns the
-        cursor or None.  Each host resumes from ITS OWN saved cursor;
-        if the host count changed since the save, the shard→host
-        assignment (``i % num_hosts``) no longer matches and the epoch
-        restarts from the beginning instead of silently skipping or
-        replaying data."""
+    def restore(self, auto: bool = False) -> dict | None:
+        """Resume from a checkpoint if one exists; returns the cursor
+        or None.  Each host resumes from ITS OWN saved cursor; if the
+        host count changed since the save, the shard→host assignment
+        (``i % num_hosts``) no longer matches and the epoch restarts
+        from the beginning instead of silently skipping or replaying
+        data.
+
+        ``auto`` (``--resume auto``, docs/ROBUSTNESS.md): walk EVERY
+        generation newest-first and restore the newest *complete,
+        loadable* one — a generation with no manifest (killed or
+        corrupted mid-commit) or a transiently unreadable one is
+        skipped with a ``checkpoint_fallback`` health row instead of
+        crashing the resume.  Plain mode keeps the LATEST-marker fast
+        path and treats an unusable checkpoint as "start fresh"."""
         if not self.cfg.checkpoint_dir:
             return None
-        path = latest_checkpoint(self.cfg.checkpoint_dir)
-        if path is None:
-            return None
-        from xflow_tpu.utils.checkpoint import IncompatibleCheckpoint
+        from xflow_tpu.chaos import ChaosError
+        from xflow_tpu.utils.checkpoint import (
+            IncompatibleCheckpoint,
+            checkpoint_candidates,
+        )
 
-        try:
-            if self.step.store is not None:
-                self.state, cursor = self.step.store.load_checkpoint(
-                    path, self.state
-                )
-            else:
-                self.state, cursor = load_checkpoint(path, self.state)
-        except IncompatibleCheckpoint as e:
-            self._log(f"ignoring unusable checkpoint: {e} — starting fresh")
+        if auto:
+            candidates = checkpoint_candidates(self.cfg.checkpoint_dir)
+        else:
+            path = latest_checkpoint(self.cfg.checkpoint_dir)
+            candidates = [path] if path is not None else []
+        cursor = None
+        for path in candidates:
+            try:
+                if self.step.store is not None:
+                    self.state, cursor = self.step.store.load_checkpoint(
+                        path, self.state
+                    )
+                else:
+                    self.state, cursor = load_checkpoint(path, self.state)
+                break
+            except IncompatibleCheckpoint as e:
+                if not auto:
+                    self._log(
+                        f"ignoring unusable checkpoint: {e} — starting "
+                        "fresh"
+                    )
+                    return None
+                self._fallback_health(path, e)
+            except (OSError, ValueError, ChaosError) as e:
+                if not auto:
+                    raise
+                self._fallback_health(path, e)
+        if cursor is None:
             return None
         self.epoch = int(cursor.get("epoch", 0))
         cursors = cursor.get("cursors")
@@ -1218,3 +1303,23 @@ class Trainer:
                 int(cursor.get("offset", 0)),
             )
         return cursor
+
+    def _fallback_health(self, path: str, err: BaseException) -> None:
+        """One skipped restore candidate (auto mode): log + health row
+        so `obs doctor` sees the fallback instead of a silent rewind."""
+        self._log(
+            f"resume auto: skipping unusable checkpoint {path} "
+            f"({type(err).__name__}: {err}) — falling back to the next "
+            "newest complete generation"
+        )
+        if self.metrics_logger is not None:
+            from xflow_tpu.obs.schema import health_row
+
+            self.metrics_logger.log("health", health_row(
+                cause="checkpoint_fallback",
+                channel="train",
+                silence_seconds=0.0,
+                threshold_seconds=0.0,
+                detail=f"{os.path.basename(path)}: "
+                f"{type(err).__name__}: {err}",
+            ))

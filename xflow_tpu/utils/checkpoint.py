@@ -34,6 +34,8 @@ import numpy as np
 
 import jax
 
+from xflow_tpu.chaos import failpoint
+
 MANIFEST = "manifest.json"
 
 _RANGE_RE = re.compile(r"\.r(\d+)-(\d+)\.npy$")
@@ -136,6 +138,10 @@ def save_checkpoint(
             f"checkpoint mkdir failed on process 0 (step {step})"
         )
     try:
+        # chaos site: a fire mid-write takes the all_ok error path —
+        # the half-written .tmp dir is cleaned and the previous
+        # committed generation stays the newest complete one
+        failpoint("ckpt.write_shard")
         arrays_meta: dict[str, Any] = {}
         for key, arr in _flat_arrays(state):
             arrays_meta[key] = {
@@ -176,6 +182,11 @@ def save_checkpoint(
             }
             with open(os.path.join(tmp, MANIFEST), "w") as f:
                 json.dump(manifest, f, indent=2)
+            # chaos site: a "kill mid-commit" — the manifest is written
+            # (inside tmp; manifest-last ordering means no final dir
+            # ever exists without one) but the rename never runs, so
+            # the generation is invisible to latest_complete()
+            failpoint("ckpt.finalize")
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.rename(tmp, final)
@@ -196,17 +207,24 @@ def save_checkpoint(
 
 
 def gc_checkpoints(directory: str, keep: int) -> list[str]:
-    """Delete all but the newest ``keep`` ckpt-* dirs (by step number —
-    the zero-padded name sorts chronologically); returns the deleted
-    paths.  The dir LATEST points at is never deleted even if a clock
-    anomaly makes it sort old.  Process-0-only in multi-host runs
-    (save_checkpoint calls it inside the rank-0 finalize block)."""
+    """Delete all but the newest ``keep`` COMPLETE ckpt-* dirs (by
+    step number — the zero-padded name sorts chronologically); returns
+    the deleted paths.  Only complete generations (manifest present)
+    count toward the keep budget and only they are deleted: a
+    manifest-less dir is external corruption, not a generation — it
+    must neither occupy a keep slot (which would leave fewer than
+    ``keep`` restorable generations for ``--resume auto``) nor be
+    silently destroyed (it is evidence).  The dir LATEST points at is
+    never deleted even if a clock anomaly makes it sort old.
+    Process-0-only in multi-host runs (save_checkpoint calls it inside
+    the rank-0 finalize block)."""
     assert keep > 0
     cands = sorted(
         d
         for d in os.listdir(directory)
         if d.startswith("ckpt-")
         and os.path.isdir(os.path.join(directory, d))
+        and is_complete(os.path.join(directory, d))
     )
     latest = None
     marker = os.path.join(directory, "LATEST")
@@ -243,6 +261,43 @@ def latest_checkpoint(directory: str) -> str | None:
         d for d in os.listdir(directory) if d.startswith("ckpt-")
     )
     return os.path.join(directory, cands[-1]) if cands else None
+
+
+def checkpoint_candidates(directory: str) -> list[str]:
+    """Every ckpt-* generation path, NEWEST first (zero-padded step in
+    the name sorts chronologically).  .tmp-ckpt-* leftovers from
+    crashed saves are never candidates."""
+    if not os.path.isdir(directory):
+        return []
+    cands = sorted(
+        (
+            d
+            for d in os.listdir(directory)
+            if d.startswith("ckpt-")
+            and os.path.isdir(os.path.join(directory, d))
+        ),
+        reverse=True,
+    )
+    return [os.path.join(directory, d) for d in cands]
+
+
+def is_complete(path: str) -> bool:
+    """A generation is COMPLETE iff its manifest exists — the commit
+    protocol writes the manifest into the tmp dir and renames last, so
+    a committed generation always has one; a manifest-less ckpt-* dir
+    is external corruption (truncated copy, partial delete)."""
+    return os.path.exists(os.path.join(path, MANIFEST))
+
+
+def latest_complete(directory: str) -> str | None:
+    """Newest COMPLETE generation, ignoring the LATEST marker (which a
+    crash or external tamper can leave stale/corrupt) — the fallback
+    `--resume auto` restores from after a kill mid-checkpoint
+    (docs/ROBUSTNESS.md)."""
+    for path in checkpoint_candidates(directory):
+        if is_complete(path):
+            return path
+    return None
 
 
 class RangeReader:
@@ -292,6 +347,18 @@ def load_checkpoint(
     template; returns (new_state, cursor).  Each process reads only the
     row ranges its devices need (mmap), so restore memory is
     O(addressable rows), not O(T)."""
+    failpoint("ckpt.restore")
+    if not is_complete(path):
+        # refuse, don't crash mid-load: a manifest-less generation is
+        # an incomplete/corrupt commit — Trainer.restore treats this
+        # as "try the next newest complete generation" (auto mode) or
+        # "no usable checkpoint" rather than a FileNotFoundError
+        raise IncompatibleCheckpoint(
+            f"checkpoint {path} has no {MANIFEST} — incomplete or "
+            "externally corrupted generation (the commit protocol "
+            "writes the manifest before the rename, so this was never "
+            "fully committed)"
+        )
     with open(os.path.join(path, MANIFEST)) as f:
         manifest = json.load(f)
     if manifest.get("format") != 2:
