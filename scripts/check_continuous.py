@@ -1,0 +1,313 @@
+"""Continuous-training gate (tier-1): the event-to-servable loop must
+close END TO END on a toy stream — shards appended DURING training
+reach a serving fleet via incremental delta export + staged rollout —
+with measured freshness, zero failed requests, chain-verified swaps,
+one injected fault absorbed, and zero leaked threads
+(docs/CONTINUOUS.md; ISSUE 12).
+
+What runs:
+
+* a writer thread converts toy text shards into packed-v2 shards and
+  drops them into the stream directory on a delay — the follower must
+  pick them up mid-run (tail mode, not a pre-listed epoch);
+* the StreamDriver trains continuously, cutting a base then deltas
+  every few steps, each rolled onto a 2-replica fleet through the
+  canary health gate with inline probe traffic;
+* ``stream.poll:nth=2`` is armed: the second directory poll fails with
+  an injected ChaosError and must heal through the bounded retry
+  (``recovered:io_retry`` health row + ``chaos`` audit row);
+* at every commit the gate exports a from-scratch FULL artifact at the
+  same step and scores a fixed probe set through BOTH paths — the
+  hot-swapped servable must match at 1e-6 (it is bitwise-equal tables,
+  so the tolerance is slack for the scoring pipeline).
+
+Gate conditions: >= 2 rollouts COMMITTED through the canary gate,
+freshness rows schema-valid with every commit age under the SLO, the
+delta exports measurably incremental (delta bytes < 25% of the base),
+zero request errors, fault accounting reconciled, doctor verdict
+clean, zero leaked threads.
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python scripts/check_continuous.py
+
+Wired into tier-1 via tests/test_stream.py::test_check_continuous_script.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PARITY_ATOL = 1e-6
+FRESHNESS_SLO_S = 30.0
+_THREAD_PREFIXES = (
+    "store-promote", "xflow-serve", "xflow-replica-revive",
+    "xflow-loadgen", "xflow-obs-watchdog",
+)
+
+
+def _leaked_threads() -> list[str]:
+    return sorted(
+        t.name for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith(_THREAD_PREFIXES)
+    )
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from tests.gen_data import generate_dataset
+    from xflow_tpu import chaos
+    from xflow_tpu.config import Config
+    from xflow_tpu.io import packed
+    from xflow_tpu.obs.doctor import diagnose
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.stream.driver import StreamDriver
+
+    errors: list[str] = []
+
+    with tempfile.TemporaryDirectory() as root:
+        ds = generate_dataset(
+            os.path.join(root, "data"),
+            num_train_shards=5,
+            lines_per_shard=200,
+            num_fields=10,
+            vocab_per_field=8,
+            seed=11,
+            scale=3.0,
+        )
+        stream_dir = os.path.join(root, "stream")
+        os.makedirs(stream_dir)
+
+        def pack(i: int) -> None:
+            packed.convert_shard(
+                f"{ds.train_prefix}-{i:05d}",
+                os.path.join(stream_dir, f"shard-{i:05d}.pk"),
+                batch_size=64,
+                max_nnz=24,
+                table_size=1 << 16,
+                hash_mode=True,
+                hash_seed=0,
+                fmt="v2",
+            )
+
+        # two shards up front (base + first delta), three appended
+        # MID-RUN — the part an epoch loader cannot do
+        pack(0)
+        pack(1)
+
+        def writer() -> None:
+            for i in (2, 3, 4):
+                time.sleep(0.9)
+                pack(i)
+
+        w = threading.Thread(target=writer, name="gate-shard-writer")
+
+        metrics = os.path.join(root, "run.jsonl")
+        cfg = Config(
+            model="lr",
+            epochs=1,
+            batch_size=64,
+            table_size_log2=16,
+            max_nnz=24,
+            num_devices=1,
+            parse_workers=1,
+            metrics_out=metrics,
+            chaos_spec="seed=3;stream.poll:nth=2",
+        )
+        rng = np.random.default_rng(0)
+        probes = [
+            rng.integers(0, 1 << 16, size=int(rng.integers(1, 12)))
+            for _ in range(32)
+        ]
+        parity: list[tuple[int, float]] = []
+
+        def on_commit(driver: StreamDriver, info: dict) -> None:
+            # the trainer still sits at the committed step: a
+            # from-scratch full export here IS "the same step"
+            ref_dir = os.path.join(root, f"ref-{info['step']}")
+            export_artifact(driver.trainer, ref_dir)
+            ref = PredictEngine.load(ref_dir, buckets=(32,), warm=False)
+            p_ref = ref.predict(ref.featurize_raw(probes))
+            p_fleet = np.asarray(
+                [driver.fleet.score(k) for k in probes]
+            )
+            parity.append(
+                (info["step"], float(np.abs(p_fleet - p_ref).max()))
+            )
+
+        driver = StreamDriver(
+            cfg,
+            stream_dir,
+            os.path.join(root, "work"),
+            replicas=2,
+            export_every_steps=4,
+            compact_every=3,
+            canary_frac=0.5,
+            min_canary_requests=6,
+            max_error_frac=0.0,
+            freshness_slo_s=FRESHNESS_SLO_S,
+            rollout_timeout_s=60.0,
+            poll_interval_s=0.2,
+            idle_stop_s=3.0,
+            buckets=(1, 8, 32),
+            log=lambda s: print(f"  driver: {s}"),
+        )
+        driver.on_commit = on_commit
+        reg = chaos.armed()
+        w.start()
+        try:
+            summary = driver.run()
+        finally:
+            w.join(timeout=30)
+
+        # -- loop-level conditions -----------------------------------------
+        if summary["commits"] < 2:
+            errors.append(
+                f"only {summary['commits']} rollout(s) committed "
+                "through the canary gate (need >= 2)"
+            )
+        if summary["shards_ingested"] < 5:
+            errors.append(
+                f"only {summary['shards_ingested']} of 5 shards "
+                "ingested — the follower missed appended files"
+            )
+        if summary["probe_errors"]:
+            errors.append(
+                f"{summary['probe_errors']} probe request(s) FAILED"
+            )
+        fleet_stats = summary.get("fleet") or {}
+        shed = fleet_stats.get("shed", {})
+        if shed.get("errors"):
+            errors.append(
+                f"fleet scored {shed['errors']} request error(s) — "
+                "the zero-failed-requests condition"
+            )
+
+        # -- parity: every swapped servable vs a full export ---------------
+        if not parity:
+            errors.append("no commit ever reached the parity check")
+        for step, worst in parity:
+            if worst > PARITY_ATOL:
+                errors.append(
+                    f"servable at step {step} diverged from the "
+                    f"from-scratch full export (max |diff| "
+                    f"{worst:.2e} > {PARITY_ATOL})"
+                )
+
+        # -- metrics stream: schema, freshness, fault accounting -----------
+        rows = load_jsonl(metrics)
+        errors.extend(validate_rows(rows))
+        fresh = [r for r in rows if r.get("kind") == "freshness"]
+        commits = [r for r in fresh if r.get("event") == "commit"]
+        if len(commits) < 2:
+            errors.append(
+                f"{len(commits)} freshness commit row(s) (need >= 2)"
+            )
+        ages = sorted(
+            float(r["newest_event_age_s"]) for r in commits
+        )
+        over = [a for a in ages if a > FRESHNESS_SLO_S]
+        if over:
+            errors.append(
+                f"{len(over)} commit(s) over the {FRESHNESS_SLO_S}s "
+                f"freshness SLO: {over}"
+            )
+        if ages:
+            p50 = ages[len(ages) // 2]
+            p99 = ages[min(len(ages) - 1, int(0.99 * len(ages)))]
+            print(
+                f"  freshness: {len(ages)} commit(s), newest-event-age"
+                f" p50={p50:.2f}s p99={p99:.2f}s (SLO {FRESHNESS_SLO_S}s)"
+            )
+        deltas = [
+            r for r in fresh
+            if r["export_kind"] == "delta" and r["event"] == "export"
+        ]
+        bases = [
+            r for r in fresh
+            if r["export_kind"] == "base" and r["event"] == "export"
+        ]
+        if deltas and bases:
+            ratio = deltas[-1]["delta_bytes"] / bases[-1]["delta_bytes"]
+            print(
+                f"  delta bytes: {deltas[-1]['delta_bytes']} vs base "
+                f"{bases[-1]['delta_bytes']} ({ratio:.1%})"
+            )
+            if ratio >= 0.25:
+                errors.append(
+                    f"delta export is {ratio:.1%} of the base — not "
+                    "incremental (need < 25%)"
+                )
+        elif not deltas:
+            errors.append("no delta export was ever cut")
+
+        fires = reg.fired() if reg is not None else {}
+        if fires.get("stream.poll", 0) < 1:
+            errors.append(
+                "the stream.poll failpoint never fired — the chaos "
+                "schedule did not reach the follower"
+            )
+        chaos_rows = [
+            r for r in rows
+            if r.get("kind") == "chaos" and r.get("site") == "stream.poll"
+        ]
+        if len(chaos_rows) != fires.get("stream.poll", 0):
+            errors.append(
+                f"stream.poll fired {fires.get('stream.poll', 0)}x "
+                f"but {len(chaos_rows)} chaos row(s) logged"
+            )
+        healed = [
+            r for r in rows
+            if r.get("kind") == "health"
+            and r.get("cause") == "recovered:io_retry"
+            and r.get("channel") == "stream"
+        ]
+        if fires.get("stream.poll") and not healed:
+            errors.append(
+                "the injected stream.poll fault has no "
+                "recovered:io_retry health row — the heal was silent"
+            )
+
+        # -- doctor verdict -------------------------------------------------
+        findings = diagnose(rows)
+        bad = [
+            f"{d.code}: {d.message}" for d in findings
+            if d.severity in ("crit", "warn")
+        ]
+        if bad:
+            errors.append(
+                f"obs doctor is not clean on the stream run: {bad}"
+            )
+
+    chaos.disarm()
+    time.sleep(0.2)  # let daemon teardown finish before the census
+    leaked = _leaked_threads()
+    if leaked:
+        errors.append(f"leaked threads after close: {leaked}")
+
+    if errors:
+        print("check_continuous: FAIL")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(
+        "check_continuous: OK — streaming ingestion -> delta export "
+        "-> canary-gated hot-swap closed end to end"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,597 @@
+"""Continuous-training subsystem (xflow_tpu/stream/; ISSUE 12,
+docs/CONTINUOUS.md): streaming ingestion, incremental delta export,
+SLO-gated hot-swap.
+
+Covers: the durable ingestion cursor's atomic flush + resume contract,
+the follower's tail-safety (never observes tmp/partial shards) and
+chaos-poll healing, delta-export round-trips (full export vs
+base+deltas bitwise-identical on dense AND tiered stores, FTRL slots
+excluded, digest-chain mismatch refused actionably), the delta-size
+acceptance bar, the packed-writer mid-write-kill regression, the
+doctor's servable_stale rankings, and the tier-1 streaming gate
+(scripts/check_continuous.py)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from xflow_tpu.config import Config  # noqa: E402
+from xflow_tpu.io import packed  # noqa: E402
+from xflow_tpu.stream.delta import (  # noqa: E402
+    TouchedLedger,
+    apply_delta,
+    delta_nbytes,
+    export_delta,
+)
+from xflow_tpu.stream.follower import (  # noqa: E402
+    IngestCursor,
+    ShardFollower,
+)
+from xflow_tpu.trainer import Trainer  # noqa: E402
+
+
+def _cfg(ds, **kw):
+    base = dict(
+        train_path=ds.train_prefix,
+        model="lr",
+        epochs=1,
+        batch_size=64,
+        table_size_log2=16,
+        max_nnz=24,
+        num_devices=1,
+        parse_workers=1,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def _pack_shard(ds, i, out_dir, name=None, table_log2=16):
+    os.makedirs(out_dir, exist_ok=True)
+    dst = os.path.join(out_dir, name or f"shard-{i:05d}.pk")
+    packed.convert_shard(
+        f"{ds.train_prefix}-{i:05d}",
+        dst,
+        batch_size=64,
+        max_nnz=24,
+        table_size=1 << table_log2,
+        hash_mode=True,
+        hash_seed=0,
+        fmt="v2",
+    )
+    return dst
+
+
+def _train_steps(trainer, ledger, n, shard=None):
+    """Drive ``n`` steps through Trainer.train_stream from one shard's
+    loader, marking the ledger per batch (the driver's hook)."""
+    src = shard or f"{trainer.cfg.train_path}-00000"
+
+    def feed():
+        taken = 0
+        while taken < n:  # loop the shard until n steps are fed
+            for batch, _ in trainer._loader(src).iter_batches():
+                if taken >= n:
+                    return
+                if ledger is not None:
+                    ledger.mark(batch)
+                taken += 1
+                yield batch, None
+
+    for _ in trainer.train_stream(feed()):
+        pass
+
+
+def _engine_tables(engine):
+    import jax
+
+    return {
+        t: np.asarray(jax.device_get(d["param"]))
+        for t, d in engine.state["tables"].items()
+    }
+
+
+# -- ingestion cursor -------------------------------------------------------
+
+
+def test_cursor_flush_atomic_roundtrip(tmp_path):
+    path = str(tmp_path / "cursor.json")
+    c = IngestCursor(path)
+    c.note("shard-00000.pk", 4096)
+    c.flush()
+    c.mark_done("shard-00000.pk")
+    c.note("shard-00001.pk", 128)
+    c.flush()
+    # atomic: no tmp residue, and a reload sees exactly the flushed state
+    assert not [n for n in os.listdir(tmp_path) if ".tmp" in n]
+    c2 = IngestCursor(path)
+    assert c2.done == {"shard-00000.pk"}
+    assert c2.current == "shard-00001.pk" and c2.offset == 128
+    # idempotent: a clean cursor's flush is a no-op (mtime stable)
+    before = os.path.getmtime(path)
+    time.sleep(0.01)
+    c2.flush()
+    assert os.path.getmtime(path) == before
+
+
+def test_trainer_close_flushes_cursor(toy_dataset, tmp_path):
+    """Satellite: Trainer.close() flushes the registered ingestion
+    cursor through the atomic tmp+os.replace path, so a graceful
+    preemption loses at most the in-flight shard (at-least-once)."""
+    cfg = _cfg(toy_dataset)
+    path = str(tmp_path / "cursor.json")
+    with Trainer(cfg) as trainer:
+        c = IngestCursor(path)
+        trainer.register_stream_cursor(c)
+        c.note("shard-00002.pk", 777)  # dirty, never explicitly flushed
+    c2 = IngestCursor(path)
+    assert c2.current == "shard-00002.pk" and c2.offset == 777
+
+
+# -- follower ---------------------------------------------------------------
+
+
+def test_follower_tails_and_skips_tmp(toy_dataset, tmp_path):
+    stream = str(tmp_path / "stream")
+    _pack_shard(toy_dataset, 0, stream)
+    # writer scratch + foreign junk must never reach the trainer
+    with open(os.path.join(stream, "shard-00001.pk.tmp.123"), "wb") as f:
+        f.write(b"garbage half-written shard")
+    cfg = _cfg(toy_dataset)
+    trainer = Trainer(cfg)
+    cursor = IngestCursor(str(tmp_path / "cursor.json"))
+    appended = []
+
+    def stop():
+        # append a second shard after the first is consumed; stop once
+        # both are done
+        if cursor.done and not appended:
+            appended.append(_pack_shard(toy_dataset, 1, stream))
+        return len(cursor.done) >= 2
+
+    fol = ShardFollower(
+        stream, trainer._loader, cursor,
+        poll_interval_s=0.05, stop=stop,
+    )
+    seen = [meta.shard for _, meta in fol.batches()]
+    trainer.close()
+    assert "shard-00000.pk" in seen
+    assert "shard-00001.pk" in seen  # tail picked up the appended file
+    assert not any(".tmp" in s for s in seen)
+    assert cursor.done == {"shard-00000.pk", "shard-00001.pk"}
+    # ingest order is stable and stamped
+    metas = seen  # names only; timestamps checked via cursor state
+    assert metas == sorted(metas)
+
+
+def test_follower_resume_skips_done_shards(toy_dataset, tmp_path):
+    stream = str(tmp_path / "stream")
+    _pack_shard(toy_dataset, 0, stream)
+    _pack_shard(toy_dataset, 1, stream)
+    cfg = _cfg(toy_dataset)
+    trainer = Trainer(cfg)
+    cpath = str(tmp_path / "cursor.json")
+    c1 = IngestCursor(cpath)
+    fol = ShardFollower(
+        stream, trainer._loader, c1,
+        poll_interval_s=0.05, idle_stop_s=0.2,
+    )
+    n_first = sum(1 for _ in fol.batches())
+    assert n_first > 0 and c1.done == {
+        "shard-00000.pk", "shard-00001.pk"
+    }
+    # a restarted follower on the durable cursor re-trains NOTHING
+    c2 = IngestCursor(cpath)
+    fol2 = ShardFollower(
+        stream, trainer._loader, c2,
+        poll_interval_s=0.05, idle_stop_s=0.2,
+    )
+    assert sum(1 for _ in fol2.batches()) == 0
+    # ... and a third shard appended later streams alone (no replay)
+    _pack_shard(toy_dataset, 2, stream)
+    c3 = IngestCursor(cpath)
+    fol3 = ShardFollower(
+        stream, trainer._loader, c3,
+        poll_interval_s=0.05, idle_stop_s=0.2,
+    )
+    shards = {meta.shard for _, meta in fol3.batches()}
+    trainer.close()
+    assert shards == {"shard-00002.pk"}
+
+
+def test_follower_poll_fault_heals(toy_dataset, tmp_path):
+    """The stream.poll failpoint: an injected transient listing fault
+    heals through the bounded retry — the stream is complete and
+    identical to the fault-free run."""
+    from xflow_tpu import chaos
+
+    stream = str(tmp_path / "stream")
+    _pack_shard(toy_dataset, 0, stream)
+    cfg = _cfg(toy_dataset)
+    trainer = Trainer(cfg)
+    try:
+        reg = chaos.arm("seed=1;stream.poll:nth=1")
+        cursor = IngestCursor(str(tmp_path / "cursor.json"))
+        fol = ShardFollower(
+            stream, trainer._loader, cursor,
+            poll_interval_s=0.05, idle_stop_s=0.2,
+        )
+        n = sum(1 for _ in fol.batches())
+        assert reg.fired().get("stream.poll") == 1
+        assert n > 0 and cursor.done == {"shard-00000.pk"}
+    finally:
+        chaos.disarm()
+        trainer.close()
+
+
+# -- packed-writer tail safety (satellite) ----------------------------------
+
+
+def test_packed_midwrite_kill_leaves_no_readable_partial(
+    toy_dataset, tmp_path
+):
+    """Kill a packed-v2 writer mid-write (SIGKILL — no cleanup runs):
+    the destination name must not exist, the only residue is a
+    ``.tmp``-infixed scratch file, and neither the format sniffer nor
+    the follower's listing can mistake it for a shard."""
+    dst = str(tmp_path / "stream" / "shard-00000.pk")
+    script = f"""
+import os, signal, sys, time
+sys.path.insert(0, {REPO!r})
+import numpy as np
+from xflow_tpu.io import packed
+from xflow_tpu.io.loader import ShardLoader
+
+loader = ShardLoader(
+    {toy_dataset.train_prefix + "-00000"!r}, batch_size=64, max_nnz=24,
+    table_size=1 << 16,
+)
+
+def batches():
+    for i, (b, _) in enumerate(loader.iter_batches()):
+        if i == 1:
+            sys.stdout.write("MID\\n"); sys.stdout.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+        yield b
+
+meta = dict(batch_size=64, cold_nnz=24, hot_nnz=0, hot_size=0,
+            table_size=1 << 16, hash_mode=True, hash_seed=0,
+            remap_sha256=None)
+packed.write_shard_v2({dst!r}, meta, batches())
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    assert "MID" in proc.stdout  # it died mid-write, not before
+    stream_dir = os.path.dirname(dst)
+    assert not os.path.exists(dst)
+    residue = os.listdir(stream_dir)
+    assert residue and all(".tmp" in n for n in residue)
+    for n in residue:
+        assert not packed.is_packed_shard(os.path.join(stream_dir, n))
+    # the follower's discovery never surfaces the residue
+    cursor = IngestCursor(str(tmp_path / "cursor.json"))
+    fol = ShardFollower(
+        stream_dir, lambda p: None, cursor, poll_interval_s=0.05,
+    )
+    assert fol.pending_shards() == []
+
+
+# -- delta export round-trips -----------------------------------------------
+
+
+def _roundtrip(ds, tmp_path, cfg, shard=None):
+    """Train → base → train more (x2) → full vs base+delta1+delta2."""
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.engine import PredictEngine
+
+    trainer = Trainer(cfg)
+    try:
+        ledger = TouchedLedger()
+        _train_steps(trainer, None, 4, shard)
+        base_dir = str(tmp_path / "base")
+        export_artifact(trainer, base_dir)
+        base_step = 4
+        _train_steps(trainer, ledger, 3, shard)
+        d1 = str(tmp_path / "delta1")
+        m1 = export_delta(trainer, d1, ledger, base_step)
+        ledger.reset()
+        _train_steps(trainer, ledger, 2, shard)
+        d2 = str(tmp_path / "delta2")
+        m2 = export_delta(trainer, d2, ledger, m1["step"])
+        full_dir = str(tmp_path / "full")
+        export_artifact(trainer, full_dir)
+    finally:
+        trainer.close()
+    # FTRL slot state never ships: param-plane files only
+    for d in (d1, d2):
+        names = os.listdir(d)
+        assert not [n for n in names if ".n." in n or ".z." in n]
+        assert any(n.endswith(".param.npy") for n in names)
+    eng = PredictEngine.load(base_dir, warm=False)
+    eng = apply_delta(eng, d1)
+    assert eng.servable_digest == m1["delta_digest"]
+    eng = apply_delta(eng, d2)
+    assert eng.servable_digest == m2["delta_digest"]
+    ref = PredictEngine.load(full_dir, warm=False)
+    assert eng.servable_digest == ref.servable_digest
+    got, want = _engine_tables(eng), _engine_tables(ref)
+    assert set(got) == set(want)
+    for t in want:
+        assert np.array_equal(got[t], want[t]), (
+            f"table {t}: base+deltas diverged from the full export"
+        )
+    import jax
+
+    for dname, arr in ref.state["dense"].items():
+        assert np.array_equal(
+            np.asarray(jax.device_get(eng.state["dense"][dname])),
+            np.asarray(jax.device_get(arr)),
+        )
+    return eng, ref
+
+
+def test_delta_roundtrip_dense_bitwise(toy_dataset, tmp_path):
+    _roundtrip(toy_dataset, tmp_path, _cfg(toy_dataset))
+
+
+def test_delta_roundtrip_dense_hot_table(toy_dataset, tmp_path):
+    """Hot-table (MXU head) geometry: hot-section ids are table rows,
+    so the ledger must cover them too."""
+    cfg = _cfg(
+        toy_dataset,
+        hot_size_log2=8,
+        hot_nnz=8,
+        freq_sample_mib=1,
+    )
+    _roundtrip(toy_dataset, tmp_path, cfg)
+
+
+def test_delta_roundtrip_tiered_bitwise(toy_dataset, tmp_path):
+    """Tiered store: delta rows read through the two-tier logical view
+    (hot tier + cold store + lazy init), still bitwise-identical to a
+    full export."""
+    cfg = _cfg(
+        toy_dataset,
+        model="fm",
+        store_mode="tiered",
+        hot_capacity_log2=10,
+        v_dim=4,
+    )
+    _roundtrip(toy_dataset, tmp_path, cfg)
+
+
+def test_delta_chain_mismatch_refused(toy_dataset, tmp_path):
+    """Out-of-order application fails loudly with the fix in the
+    message — never silently skews weights."""
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.engine import PredictEngine
+
+    cfg = _cfg(toy_dataset)
+    trainer = Trainer(cfg)
+    try:
+        ledger = TouchedLedger()
+        _train_steps(trainer, None, 2)
+        base_dir = str(tmp_path / "base")
+        export_artifact(trainer, base_dir)
+        _train_steps(trainer, ledger, 2)
+        d1 = str(tmp_path / "delta1")
+        export_delta(trainer, d1, ledger, 2)
+        ledger.reset()
+        _train_steps(trainer, ledger, 2)
+        d2 = str(tmp_path / "delta2")
+        export_delta(trainer, d2, ledger, 4)
+    finally:
+        trainer.close()
+    eng = PredictEngine.load(base_dir, warm=False)
+    with pytest.raises(ValueError) as ei:
+        apply_delta(eng, d2)  # skipped delta1 — chain broken
+    msg = str(ei.value)
+    assert "digest-chain mismatch" in msg
+    assert "intervening deltas" in msg  # actionable: what to do
+    # the chain applies cleanly in order
+    eng = apply_delta(eng, d1)
+    eng = apply_delta(eng, d2)
+    # ... and a delta never applies twice
+    with pytest.raises(ValueError, match="digest-chain mismatch"):
+        apply_delta(eng, d1)
+
+
+def test_delta_bytes_incremental_at_2e22(toy_dataset, tmp_path):
+    """Acceptance: for a run touching <10% of rows between exports,
+    delta bytes < 25% of a full export at table_size_log2 >= 22."""
+    from xflow_tpu.serve.artifact import export_artifact
+
+    cfg = _cfg(toy_dataset, table_size_log2=22)
+    trainer = Trainer(cfg)
+    try:
+        ledger = TouchedLedger()
+        _train_steps(trainer, None, 2)
+        _train_steps(trainer, ledger, 2)
+        touched_frac = len(ledger) / cfg.table_size
+        assert touched_frac < 0.10  # the premise of the bar
+        d = str(tmp_path / "delta")
+        export_delta(trainer, d, ledger, 2)
+        full = str(tmp_path / "full")
+        export_artifact(trainer, full)
+    finally:
+        trainer.close()
+    ratio = delta_nbytes(d) / delta_nbytes(full)
+    assert ratio < 0.25, (
+        f"delta is {ratio:.1%} of a full export — not incremental"
+    )
+
+
+def test_driver_checkpoint_restart_consistent(toy_dataset, tmp_path):
+    """With --checkpoint-dir, a restarted driver restores the model
+    AND rewinds the ingestion cursor to the checkpoint's embedded
+    snapshot: shards trained after the checkpoint REPLAY on the
+    restored weights (at-least-once) — a restart can never train new
+    shards on fresh weights while the cursor skips the old ones."""
+    import jax
+
+    from xflow_tpu.stream.driver import StreamDriver
+
+    stream = str(tmp_path / "stream")
+    _pack_shard(toy_dataset, 0, stream)
+    _pack_shard(toy_dataset, 1, stream)
+    work = str(tmp_path / "work")
+    cfg = _cfg(toy_dataset, checkpoint_dir=str(tmp_path / "ck"))
+    kw = dict(
+        replicas=1, export_every_steps=3, min_canary_requests=2,
+        canary_frac=1.0, idle_stop_s=0.4, poll_interval_s=0.05,
+        rollout_timeout_s=30.0, buckets=(1, 8),
+    )
+    d1 = StreamDriver(cfg, stream, work, **kw)
+    s1 = d1.run()
+    assert s1["exports"] >= 1 and s1["shards_ingested"] == 2
+    # run 1 finished the stream: its BOUNDARY cursor marks both done,
+    # but the checkpoint embedded the snapshot at its export step
+    d2 = StreamDriver(cfg, stream, work, resume="auto", **kw)
+    try:
+        restored_step = int(jax.device_get(d2.trainer.state["step"]))
+        assert restored_step > 0 and restored_step % 3 == 0
+        # cursor rewound to the checkpoint: the stream AFTER the
+        # checkpoint is pending again, not skipped
+        assert not (
+            d2.cursor.done == {"shard-00000.pk", "shard-00001.pk"}
+            and d2.cursor.current is None
+        )
+        pending = d2.follower.pending_shards()
+        assert pending, "rewound cursor left nothing to replay"
+    finally:
+        d2.close()
+    # a fresh-model restart against a populated cursor warns loudly
+    logs: list[str] = []
+    d3 = StreamDriver(
+        _cfg(toy_dataset), stream, work, log=logs.append, **kw
+    )
+    d3.close()
+    assert any("MODEL starts fresh" in s for s in logs)
+
+
+# -- doctor: servable_stale -------------------------------------------------
+
+
+def _header():
+    return {
+        "t": 0.0, "kind": "run_start", "run_id": "r1",
+        "config_digest": "cfg0", "rank": 0, "num_hosts": 1,
+        "time_unix": 1000.0,
+    }
+
+
+def _fresh_row(event, age, slo=30.0, step=10):
+    return {
+        "t": 1.0, "kind": "freshness", "event": event,
+        "newest_event_age_s": age, "slo_s": slo, "servable": "s1",
+        "export_kind": "delta", "step": step, "rows": 10,
+        "delta_bytes": 100, "deltas_since_base": 1,
+    }
+
+
+def _rollout_row(event):
+    return {
+        "t": 2.0, "kind": "rollout", "event": event,
+        "from_digest": "aaa", "to_digest": "aaa",
+        "canary_frac": 0.25, "canary_requests": 10,
+        "canary_errors": 0, "detail": "",
+    }
+
+
+def _doctor(tmp_path, rows):
+    from xflow_tpu.obs.doctor import diagnose, format_diagnosis
+
+    findings = diagnose(rows)
+    return findings, format_diagnosis("x", rows, findings)
+
+
+def test_doctor_servable_stale_over_slo(tmp_path):
+    rows = [
+        _header(),
+        _rollout_row("begin"), _rollout_row("commit"),
+        _fresh_row("commit", 5.0),
+        _fresh_row("commit", 95.0),  # last row is over the 30s SLO
+    ]
+    findings, text = _doctor(tmp_path, rows)
+    stale = [f for f in findings if f.code == "servable_stale"]
+    assert stale and stale[0].severity == "warn"
+    assert "over the 30s SLO" in text
+
+    # healthy stream: no servable_stale, diagnosis clean
+    rows[-1] = _fresh_row("commit", 3.0)
+    findings, text = _doctor(tmp_path, rows)
+    assert not [f for f in findings if f.code == "servable_stale"]
+    assert "clean" in text
+
+
+def test_doctor_servable_stale_repeated_aborts(tmp_path):
+    rows = [
+        _header(),
+        _rollout_row("begin"), _rollout_row("commit"),
+        _fresh_row("commit", 2.0),
+        _rollout_row("begin"), _rollout_row("abort"),
+        _fresh_row("abort", 10.0),
+        _rollout_row("begin"), _rollout_row("abort"),
+        _fresh_row("abort", 20.0),
+    ]
+    findings, text = _doctor(tmp_path, rows)
+    stale = [f for f in findings if f.code == "servable_stale"]
+    assert stale and "repeatedly aborting" in text
+    # one commit resets the abort streak
+    rows += [_rollout_row("commit"), _fresh_row("commit", 2.0)]
+    findings, _ = _doctor(tmp_path, rows)
+    assert not [
+        f for f in findings
+        if f.code == "servable_stale"
+        and "aborting" in f.message
+    ]
+
+
+def test_doctor_servable_stale_begin_without_commit(tmp_path):
+    """The begin-with-no-commit case: a stream run that cut and
+    canaried exports but never shipped one is stale AND canary-stuck,
+    never clean."""
+    rows = [
+        _header(),
+        _fresh_row("export", 1.0),
+        _rollout_row("begin"), _rollout_row("canary"),
+    ]
+    findings, text = _doctor(tmp_path, rows)
+    codes = {f.code for f in findings if f.severity == "warn"}
+    assert "servable_stale" in codes
+    assert "canary_stuck" in codes
+    assert "never committed" in text
+
+
+# -- tier-1 gate ------------------------------------------------------------
+
+
+def test_check_continuous_script():
+    """The continuous-training gate (scripts/check_continuous.py)
+    passes — run as a subprocess exactly as CI would (tier-1 wiring,
+    like check_chaos.py)."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "check_continuous.py"),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

@@ -361,21 +361,7 @@ class CompactBatch:
             keys_flat[~cflags] = _unpack_keys(
                 self.ct, self.n_cold - self.n_dict_occ
             )
-        hflags = _unpack_bits(self.hf, self.n_hot).astype(bool)
-        hot_flat = np.zeros(self.n_hot, np.int64)
-        hot_flat[hflags] = self.h8[: self.n_h8].astype(np.int64)
-        n_hx = self.n_hot - self.n_h8
-        if n_hx:
-            if self.hx16:
-                hot_flat[~hflags] = self.hx[:n_hx].astype(np.int64)
-            else:
-                hi = np.repeat(self.hxh, 2)[:n_hx].astype(np.int64)
-                hi = np.where(
-                    np.arange(n_hx) % 2 == 0, hi & 0xF, hi >> 4
-                )
-                hot_flat[~hflags] = self.hx[:n_hx].astype(np.int64) | (
-                    hi << 8
-                )
+        hot_flat = self._hot_ids()
 
         def unflatten(flat, counts, width, dtype):
             out = np.zeros((b, width), dtype)
@@ -399,6 +385,41 @@ class CompactBatch:
             hot_vals=hm.copy(),
             hot_mask=hm,
         )
+
+    def _hot_ids(self) -> np.ndarray:
+        """Flat hot-section row ids (original occurrence order)
+        reconstructed from the tiered u8/u12/u16 planes — shared by
+        expand() and touched_rows()."""
+        hflags = _unpack_bits(self.hf, self.n_hot).astype(bool)
+        hot_flat = np.zeros(self.n_hot, np.int64)
+        hot_flat[hflags] = self.h8[: self.n_h8].astype(np.int64)
+        n_hx = self.n_hot - self.n_h8
+        if n_hx:
+            if self.hx16:
+                hot_flat[~hflags] = self.hx[:n_hx].astype(np.int64)
+            else:
+                hi = np.repeat(self.hxh, 2)[:n_hx].astype(np.int64)
+                hi = np.where(
+                    np.arange(n_hx) % 2 == 0, hi & 0xF, hi >> 4
+                )
+                hot_flat[~hflags] = self.hx[:n_hx].astype(np.int64) | (
+                    hi << 8
+                )
+        return hot_flat
+
+    def touched_rows(self) -> np.ndarray:
+        """Big-table row ids this batch touches — cold dictionary keys,
+        cold tail occurrences (may repeat), and hot-section ids (row
+        ids in [0, hot_size) by construction).  The delta-export
+        ledger's per-batch input (stream/delta.py): available straight
+        off the compact planes, no expand() cost."""
+        parts = [
+            _unpack_keys(self.cu, self.n_dict),
+            _unpack_keys(self.ct, self.n_cold - self.n_dict_occ),
+        ]
+        if self.n_hot:
+            parts.append(self._hot_ids())
+        return np.concatenate(parts)
 
     # -- wire --------------------------------------------------------------
 

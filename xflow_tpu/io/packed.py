@@ -33,6 +33,13 @@ Records have constant size, so a resume offset is plain arithmetic and
 random access is free.  The final (partial) batch of a shard is stored
 as-is — weights already encode padding.
 
+Tail safety: every writer streams into a ``<dst>.tmp.<pid>`` scratch
+name, fsyncs, and ``os.replace``s on finalize — a reader (including
+the continuous-training ShardFollower tailing a growing directory,
+stream/follower.py) can NEVER observe a half-written shard at the
+final name; a mid-write kill leaves only the scratch file, which every
+consumer skips by its ``.tmp`` infix.
+
 Convert via the CLI (from text or CSR-binary shards):
 
     python -m xflow_tpu.io.packed --train PREFIX --out PREFIX.pk \
@@ -170,6 +177,8 @@ def write_shard(
                 examples += batch.num_real()
             header.update({"batches": n_batches, "examples": examples})
             container.rewrite_header(f, MAGIC, header, hdr_len)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, dst)
     finally:
         if os.path.exists(tmp):
@@ -253,6 +262,8 @@ def write_shard_v2(
                 examples += cb.n_real
             header.update({"batches": n_batches, "examples": examples})
             container.rewrite_header(f, MAGIC, header, hdr_len)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, dst)
     finally:
         if os.path.exists(tmp):

@@ -23,6 +23,9 @@ diagnosis instead of raw JSONL:
   admission control rejected most offered traffic — blamed on
   capacity, explicitly NOT on the queue) and canary-stuck rollouts
   (a ``rollout`` stream that ends on ``begin``/``canary``);
+* continuous training → servable-stale streams (``freshness`` rows,
+  docs/CONTINUOUS.md): last newest-event-age over its SLO, rollouts
+  repeatedly aborting, or begins that never commit;
 * chaos fabric → ``chaos`` rows correlated with the self-healing
   ``health`` causes: fault storm vs isolated recovery, with
   ``quarantine_budget_exceeded`` (data corruption, not an input
@@ -434,6 +437,72 @@ def _check_serve(
     return out
 
 
+def _check_freshness(rows: list[dict]) -> list[Diagnosis]:
+    """Continuous-training freshness (stream/driver.py ``freshness``
+    rows; docs/CONTINUOUS.md).  A stream run must not read as clean
+    when its servable is stale:
+
+    * the LAST freshness row's newest-event-age exceeds its SLO — the
+      fleet is serving a model older than the decay budget;
+    * rollouts repeatedly abort (>= 2 aborts after the last commit) —
+      exports keep failing the canary gate, so freshness can only
+      decay from here;
+    * a rollout BEGAN and never committed in a stream run (the
+      begin-with-no-commit case): the run produced servables it never
+      shipped — _check_serve's canary_stuck names the wedged rollout,
+      this names the freshness consequence."""
+    out: list[Diagnosis] = []
+    for run in split_runs(rows):
+        fresh = [r for r in run.rows if r.get("kind") == "freshness"]
+        if not fresh:
+            continue
+        last = fresh[-1]
+        age = float(last.get("newest_event_age_s", 0.0))
+        slo = float(last.get("slo_s", 0.0))
+        if slo > 0 and age > slo:
+            out.append(Diagnosis(
+                "warn",
+                "servable_stale",
+                f"stale servable: the stream's last freshness row "
+                f"({last.get('event')!r} at step {last.get('step')}) "
+                f"reports newest-event-age {age:.1f}s over the "
+                f"{slo:.0f}s SLO — ingested events are not reaching "
+                "the serving fleet; check rollout aborts and export "
+                "cadence (docs/CONTINUOUS.md)",
+            ))
+        aborts_since_commit = 0
+        for r in fresh:
+            if r.get("event") == "commit":
+                aborts_since_commit = 0
+            elif r.get("event") == "abort":
+                aborts_since_commit += 1
+        if aborts_since_commit >= 2:
+            out.append(Diagnosis(
+                "warn",
+                "servable_stale",
+                f"rollouts repeatedly aborting: "
+                f"{aborts_since_commit} consecutive abort(s) since "
+                "the last committed swap — every refresh is failing "
+                "the canary health gate, so the serving fleet keeps "
+                "aging; inspect the rollout rows' gate verdicts "
+                "(docs/CONTINUOUS.md)",
+            ))
+        rrows = [r for r in run.rows if r.get("kind") == "rollout"]
+        began = any(r.get("event") == "begin" for r in rrows)
+        committed = any(r.get("event") == "commit" for r in rrows)
+        if began and not committed:
+            out.append(Diagnosis(
+                "warn",
+                "servable_stale",
+                "stream run began rollout(s) but never committed one: "
+                "exports were cut and canaried but no swap ever "
+                "landed — the fleet still serves the original base "
+                "while the model trains ahead (see the canary_stuck "
+                "finding for the wedged rollout itself)",
+            ))
+    return out
+
+
 def _check_chaos(rows: list[dict]) -> list[Diagnosis]:
     """Chaos-fabric forensics (xflow_tpu/chaos/, docs/ROBUSTNESS.md):
     correlate ``chaos`` rows (injected faults) with the self-healing
@@ -637,6 +706,7 @@ def diagnose(
             d.code == "serve_queue_stall" for d in findings
         ),
     ))
+    findings.extend(_check_freshness(rows))
     if flight is not None:
         findings.extend(_check_flight(flight))
     findings.extend(_check_phases(rows))

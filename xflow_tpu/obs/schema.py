@@ -205,6 +205,27 @@ SCHEMA: dict[str, dict[str, Any]] = {
         "canary_errors": int,
         "detail": str,
     },
+    # one per continuous-training export/rollout transition
+    # (stream/driver.py; docs/CONTINUOUS.md): event is export (a
+    # delta/base was cut) / commit (the canary gate passed and the
+    # fleet swapped — for commits, newest_event_age_s IS the
+    # event-to-servable freshness the SLO is about) / abort (the gate
+    # refused; the fleet stays on the incumbent and freshness keeps
+    # aging).  `obs doctor` ranks a stream whose last row exceeds
+    # slo_s, or whose rollouts repeatedly abort, as servable_stale.
+    "freshness": {
+        "t": (int, float),
+        "kind": str,
+        "event": str,
+        "newest_event_age_s": (int, float),
+        "slo_s": (int, float),
+        "servable": str,
+        "export_kind": str,
+        "step": int,
+        "rows": int,
+        "delta_bytes": int,
+        "deltas_since_base": int,
+    },
     # -- robustness (xflow_tpu/chaos/; docs/ROBUSTNESS.md) -----------------
     # one per failpoint FIRE when the chaos fabric is armed
     # (Config.chaos_spec / XFLOW_CHAOS): site is the failpoint name,

@@ -164,6 +164,32 @@ def format_run(run: Run) -> str:
             "(cold occurrences per table touch; docs/PERF.md "
             "\"Wire format and compaction\")"
         )
+    fresh = run.kind("freshness")
+    if fresh:
+        commits = sorted(
+            float(r.get("newest_event_age_s", 0.0))
+            for r in fresh if r.get("event") == "commit"
+        )
+        aborts = sum(1 for r in fresh if r.get("event") == "abort")
+        last = fresh[-1]
+        line = (
+            f"freshness: {len(commits)} commit(s), {aborts} abort(s)"
+        )
+        if commits:
+            p50 = commits[len(commits) // 2]
+            p99 = commits[min(len(commits) - 1,
+                              int(0.99 * len(commits)))]
+            line += (
+                f", newest-event-age p50/p99 = {p50:.1f}/{p99:.1f}s "
+                f"(SLO {float(last.get('slo_s', 0.0)):.0f}s)"
+            )
+        line += (
+            f"; last: {last.get('event')} {last.get('export_kind')} "
+            f"step {last.get('step')} "
+            f"({last.get('delta_bytes', 0)} B, "
+            f"{last.get('rows', 0)} row(s))"
+        )
+        out.append(line)
     shards = run.shards
     if shards:
         rates = [s.get("examples_per_sec", 0.0) for s in shards]

@@ -46,6 +46,21 @@ FORMAT = 1
 REMAP_FILE = "remap.npy"
 
 
+def servable_digest(config_digest: str, step: int) -> str:
+    """Identity of one SERVABLE — a (config, train-step) point in the
+    continuous-training chain (docs/CONTINUOUS.md).  A full export at
+    step S and base + deltas applied up to step S are the same model
+    by the delta round-trip guarantee, so both carry this digest:
+    incremental deltas chain on it (``base_digest`` → ``delta_digest``,
+    stream/delta.py) and ``PredictEngine``/``ReplicaFleet`` refuse a
+    delta whose base is not the servable they currently hold."""
+    import hashlib
+
+    return hashlib.sha256(
+        f"{config_digest}@{int(step)}".encode()
+    ).hexdigest()[:16]
+
+
 def export_artifact(trainer, directory: str) -> str:
     """Freeze ``trainer``'s model into a serving artifact at
     ``directory`` (replaced atomically if it exists); returns the path.

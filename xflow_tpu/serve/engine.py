@@ -137,6 +137,15 @@ class PredictEngine:
             sorted({-(-b // ndev) * ndev for b in raw})
         )
         self.state = self._strip_state(state)
+        # Servable identity for the continuous-training delta chain
+        # (docs/CONTINUOUS.md): (config digest, train step), shared
+        # with full exports and deltas via serve/artifact.py::
+        # servable_digest — apply_delta refuses a delta whose base is
+        # not this.  Resolved LAZILY: the step scalar's device_get
+        # would otherwise serialize every live-state update_state()
+        # (XFlow.predict_batch calls it per batch) against pending
+        # dispatch.
+        self._servable_step: int | None = None
         # AOT executables keyed by (batch_rows, cold_nnz, hot_nnz) —
         # canonical traffic only ever sees len(buckets) keys.  The dict
         # may be SHARED across ``clone()`` replicas: executables are
@@ -152,6 +161,42 @@ class PredictEngine:
     @property
     def compile_count(self) -> int:
         return len(self._compiled)
+
+    @property
+    def servable_step(self) -> int:
+        """Train step of the served state (one cached scalar fetch,
+        booked — XF002)."""
+        if self._servable_step is None:
+            with self.obs.phase("serve_state_sync"):
+                self._servable_step = int(
+                    jax.device_get(self.state["step"])
+                )
+        return self._servable_step
+
+    @servable_step.setter
+    def servable_step(self, step: int) -> None:
+        self._servable_step = int(step)
+
+    @property
+    def servable_digest(self) -> str:
+        """Identity of the model VERSION being served — (config digest,
+        train step), the continuous-training chain anchor
+        (serve/artifact.py::servable_digest).  Distinct from
+        ``digest``: that is the config/geometry identity (unchanged by
+        a delta), this advances with every applied refresh."""
+        from xflow_tpu.serve.artifact import servable_digest
+
+        return servable_digest(self.digest, self.servable_step)
+
+    def apply_delta(self, directory: str) -> "PredictEngine":
+        """Fold an incremental delta export (stream/delta.py) onto
+        this servable; returns a NEW engine at the delta's step with
+        shared AOT executables (zero recompiles) — this engine keeps
+        serving untouched, so fleets canary the result through the
+        staged-rollout gate before committing traffic to it."""
+        from xflow_tpu.stream.delta import apply_delta
+
+        return apply_delta(self, directory)
 
     # -- construction ------------------------------------------------------
 
@@ -257,6 +302,7 @@ class PredictEngine:
         )
         replica.state = self.state  # share, don't re-strip-copy
         replica._compiled = self._compiled
+        replica._servable_step = self._servable_step
         replica.warm_seconds = self.warm_seconds
         return replica
 
@@ -278,6 +324,7 @@ class PredictEngine:
         trainer state after more steps).  The AOT executables take the
         state as an argument, so no recompilation happens."""
         self.state = self._strip_state(state)
+        self._servable_step = None  # re-resolve lazily on next use
 
     # -- warmup / compilation ----------------------------------------------
 

@@ -198,6 +198,12 @@ class ReplicaFleet:
         self._final_rows: dict = {}
         self._load_kw: dict[str, Any] = {}
         self.digest = engine.digest
+        # servable identity (config digest @ step — serve/artifact.py
+        # ::servable_digest): advances on every COMMITTED rollout,
+        # including delta refreshes where the config digest does not
+        # change; the continuous driver and /v1/stats read it to tell
+        # which model VERSION traffic converged on
+        self.servable = getattr(engine, "servable_digest", "?")
 
     # -- construction -------------------------------------------------------
 
@@ -584,7 +590,10 @@ class ReplicaFleet:
                 max_error_frac, max_p99_ms, auto_commit, force,
             )
             self._log_rollout(
-                "begin", ro, f"canary replica {ro['canary']}"
+                "begin", ro,
+                f"canary replica {ro['canary']}; servable "
+                f"{getattr(ro['old'], 'servable_digest', '?')} -> "
+                f"{getattr(candidate, 'servable_digest', '?')}",
             )
         return self.rollout_state()
 
@@ -716,6 +725,7 @@ class ReplicaFleet:
                 b.swap(next(it), force=force or ro["force"])
                 self.engines[i] = b.engine
             self.digest = candidate.digest
+            self.servable = getattr(candidate, "servable_digest", "?")
             self._rollout = None
         with self._ro_log_lock:
             self._log_rollout("commit", ro, f"health {health}")
@@ -735,6 +745,27 @@ class ReplicaFleet:
         with self._ro_log_lock:
             self._log_rollout("abort", ro, detail or f"health {health}")
         return health
+
+    def rollout_delta(self, delta_dir: str, **gate_kw) -> dict:
+        """Begin a staged rollout of an incremental delta export
+        (stream/delta.py, docs/CONTINUOUS.md): the candidate is built
+        by applying the delta onto the incumbent servable —
+        ``PredictEngine.apply_delta`` verifies the digest chain and
+        shares the AOT executables, so the refresh costs zero
+        recompiles — and then rides the SAME canary health gate as a
+        full-artifact rollout (``gate_kw`` = begin_rollout's knobs).
+        The chain check runs before any traffic shifts."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ReplicaFleet is closed")
+            if self._rollout is not None:
+                raise RolloutError(
+                    "a rollout is already open (commit or abort it "
+                    "first)"
+                )
+            inc = self.engines[0]
+        candidate = inc.apply_delta(delta_dir)
+        return self.begin_rollout(candidate, **gate_kw)
 
     def rollout_tick(self) -> str | None:
         """Advance an auto rollout: commit once the health gate passes,
@@ -836,6 +867,7 @@ class ReplicaFleet:
             engine0 = self.engines[0]
         return {
             "digest": self.digest,
+            "servable": self.servable,
             "replicas": self.replicas,
             "stats": stats_row_from_snapshot(snap),
             "shed": shed,

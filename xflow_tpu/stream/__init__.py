@@ -1,0 +1,42 @@
+"""Continuous training (docs/CONTINUOUS.md) — the event-to-servable
+loop the batch trainer cannot close.
+
+Ads models decay in hours (the online-advertising framework paper,
+arXiv:2201.05500, and Google's ads training/serving stack,
+arXiv:2501.10546, both make continuous train→export→swap the core
+production loop).  This package closes that loop end to end over the
+subsystems the previous PRs landed:
+
+* :mod:`xflow_tpu.stream.follower` — ``ShardFollower`` tails a growing
+  packed-v2 shard directory (atomic-rename writers mean presence ==
+  complete) behind a durable ``IngestCursor``, so a restarted run
+  resumes mid-stream without re-training or skipping shards
+  (at-least-once: replay is bounded by one shard).
+* :mod:`xflow_tpu.stream.delta` — ``export_delta`` ships only the rows
+  touched since the last export as a digest-chained artifact
+  (``base_digest`` → ``delta_digest``); ``apply_delta`` folds it onto a
+  loaded ``PredictEngine`` in place (param-only, FTRL slots never
+  ship).
+* :mod:`xflow_tpu.stream.driver` — ``StreamDriver`` wires follower →
+  trainer → periodic delta export → ``ReplicaFleet`` staged rollout
+  (PR 10's canary health gate), stamping every ingested batch so the
+  ``freshness`` metric (newest-event-age at swap commit) is measured,
+  not estimated.  ``python -m xflow_tpu.stream run`` is the CLI.
+"""
+
+from xflow_tpu.stream.delta import (
+    TouchedLedger,
+    apply_delta,
+    export_delta,
+    load_delta_manifest,
+)
+from xflow_tpu.stream.follower import IngestCursor, ShardFollower
+
+__all__ = [
+    "IngestCursor",
+    "ShardFollower",
+    "TouchedLedger",
+    "apply_delta",
+    "export_delta",
+    "load_delta_manifest",
+]

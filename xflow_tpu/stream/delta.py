@@ -1,0 +1,318 @@
+"""Incremental delta export — ship only the rows training touched.
+
+A full serving artifact at north-star geometry is ~GBs (2^28 rows × D
+× 4 B per table); between two exports minutes apart a continuous
+trainer touches a small fraction of those rows (zipf traffic), so
+shipping the full table per refresh wastes ~the whole artifact.  A
+**delta** holds exactly:
+
+* ``delta.keys.npy`` — the sorted logical row ids touched since the
+  base (the :class:`TouchedLedger`'s accumulated set — fed per batch
+  from ``Batch`` masks or ``CompactBatch.touched_rows()``; the tiered
+  store's cold ledger + hot ``key_of`` name the same rows);
+* ``delta.<table>.param.npy`` — the CURRENT param rows for those ids,
+  param plane ONLY (FTRL n/z never serve — same exclusion as the full
+  artifact, serve/artifact.py);
+* ``dense.<name>.npy`` — replicated dense params in full (MLP weights
+  change every step and are tiny next to one table chunk);
+* ``delta_manifest.json`` — config + digest chain + a content sha.
+
+**Digest chain.**  Every servable has an identity
+``servable_digest(config_digest, step)`` (serve/artifact.py): a full
+export at step S and base + deltas applied through step S are the same
+model (the bitwise round-trip test pins it), so they share the
+identity.  A delta records the chain edge ``base_digest →
+delta_digest``; ``apply_delta`` refuses a delta whose ``base_digest``
+is not the engine's current servable — out-of-order or cross-model
+application fails loudly with the fix in the message, never silently
+skews weights.
+
+**Compaction.**  Deltas grow with the union of touched rows since the
+base; the loop driver (stream/driver.py) cuts a fresh FULL base every
+``compact_every`` deltas and resets the ledger, bounding both delta
+size and the chain an operator must replay after a cold start.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.serve.artifact import servable_digest
+
+DELTA_MANIFEST = "delta_manifest.json"
+DELTA_FORMAT = 1
+
+
+class TouchedLedger:
+    """Union of big-table row ids touched since the last export.
+
+    Fed per ingested batch on the host side (the batch is in hand
+    anyway — ``mark()`` is one masked-unique over planes already in
+    cache), which makes the ledger identical for every store mode:
+    dense, MXU-hot (hot-section ids ARE table rows [0, hot_size)),
+    and tiered (the driver marks the same batches the store plans).
+    """
+
+    def __init__(self):
+        self._keys: set[int] = set()
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def mark(self, batch) -> None:
+        """Accumulate one Batch or CompactBatch's touched rows."""
+        if hasattr(batch, "touched_rows"):  # CompactBatch — no expand
+            self._keys.update(
+                np.unique(batch.touched_rows()).tolist()
+            )
+            return
+        touched = batch.keys[batch.mask > 0]
+        if batch.hot_nnz:
+            touched = np.concatenate(
+                [touched, batch.hot_keys[batch.hot_mask > 0]]
+            )
+        self._keys.update(np.unique(touched).tolist())
+
+    def mark_rows(self, rows: np.ndarray) -> None:
+        self._keys.update(np.asarray(rows).tolist())
+
+    def keys(self) -> np.ndarray:
+        """Sorted int64 ids — the delta's key plane."""
+        return np.asarray(sorted(self._keys), np.int64)
+
+    def reset(self) -> None:
+        self._keys.clear()
+
+
+def _param_rows(trainer, table: str, keys: np.ndarray) -> np.ndarray:
+    """Current param rows for logical ids ``keys``, either store mode:
+    tiered reads through the two-tier logical view (store/tiered.py —
+    flushes the pending write-back first), dense gathers on device so
+    only the touched rows cross back to the host."""
+    store = getattr(trainer.step, "store", None)
+    if store is not None:
+        return np.asarray(
+            store.logical_rows(trainer.state, table, keys)["param"],
+            np.float32,
+        )
+    param = trainer.state["tables"][table]["param"]
+    rows = jnp.take(param, jnp.asarray(keys, jnp.int32), axis=0)
+    return np.asarray(jax.device_get(rows), np.float32)
+
+
+def export_delta(
+    trainer,
+    directory: str,
+    ledger: TouchedLedger,
+    base_step: int,
+) -> dict:
+    """Freeze the rows ``ledger`` accumulated since the export at
+    ``base_step`` into a delta artifact at ``directory`` (atomic tmp +
+    rename, replacing any previous delta there); returns the manifest.
+    Single-process (the continuous driver's topology; multi-host
+    export stays the full-artifact path)."""
+    if jax.process_count() > 1:
+        raise RuntimeError(
+            "export_delta is single-process — multi-host runs export "
+            "full artifacts (serve/artifact.py)"
+        )
+    cfg = trainer.cfg
+    step = int(jax.device_get(trainer.state["step"]))
+    keys = ledger.keys()
+    parent = os.path.dirname(os.path.abspath(directory))
+    tmp = os.path.join(
+        parent, f".tmp-delta-{os.path.basename(directory)}"
+    )
+    os.makedirs(parent, exist_ok=True)
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    sha = hashlib.sha256()
+    sha.update(keys.tobytes())
+    np.save(os.path.join(tmp, "delta.keys.npy"), keys)
+    arrays_meta: dict = {}
+    # name-sorted table order: apply_delta folds the content sha in
+    # sorted(state["tables"]) order, so export must hash the same way
+    for spec in sorted(trainer.model.tables(), key=lambda s: s.name):
+        rows = _param_rows(trainer, spec.name, keys)
+        sha.update(rows.tobytes())
+        arrays_meta[f"{spec.name}.param"] = {
+            "shape": list(rows.shape),
+            "dtype": "float32",
+        }
+        np.save(os.path.join(tmp, f"delta.{spec.name}.param.npy"), rows)
+    dense_names = sorted(trainer.state.get("dense", {}))
+    for dname in dense_names:
+        host = np.asarray(
+            jax.device_get(trainer.state["dense"][dname])
+        )
+        sha.update(host.tobytes())
+        np.save(os.path.join(tmp, f"dense.{dname}.npy"), host)
+    manifest = {
+        "format": DELTA_FORMAT,
+        "kind": "delta",
+        "model": cfg.model,
+        "config": cfg.to_json(),
+        "config_digest": cfg.digest(),
+        "step": step,
+        "base_step": int(base_step),
+        "base_digest": servable_digest(cfg.digest(), base_step),
+        "delta_digest": servable_digest(cfg.digest(), step),
+        "rows": int(len(keys)),
+        "arrays": arrays_meta,
+        "dense": dense_names,
+        "content_sha256": sha.hexdigest(),
+        "created_unix": round(time.time(), 3),
+    }
+    with open(os.path.join(tmp, DELTA_MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=2)
+    if os.path.exists(directory):
+        shutil.rmtree(directory)
+    os.rename(tmp, directory)
+    return manifest
+
+
+def delta_nbytes(directory: str) -> int:
+    """Total artifact bytes on disk (delta or full — the number behind
+    the "delta bytes < 25% of a full export" acceptance check)."""
+    total = 0
+    for name in os.listdir(directory):
+        total += os.path.getsize(os.path.join(directory, name))
+    return total
+
+
+def load_delta_manifest(directory: str) -> dict:
+    """Parse + integrity-check a delta manifest (the full-artifact
+    ``load_manifest`` counterpart): format, digest-chain consistency
+    with the embedded config, and the content sha over keys + rows."""
+    from xflow_tpu.config import Config
+
+    path = os.path.join(directory, DELTA_MANIFEST)
+    if not os.path.exists(path):
+        raise ValueError(
+            f"{directory}: no delta manifest ({DELTA_MANIFEST}) — a "
+            "FULL artifact loads via PredictEngine.load, not apply_delta"
+        )
+    with open(path) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != DELTA_FORMAT:
+        raise ValueError(
+            f"{directory}: unsupported delta format "
+            f"{manifest.get('format')!r} (expected {DELTA_FORMAT})"
+        )
+    cfg = Config.from_json(manifest["config"])
+    if cfg.digest() != manifest.get("config_digest"):
+        raise ValueError(
+            f"{directory}: delta config_digest "
+            f"{manifest.get('config_digest')!r} does not match the "
+            f"embedded config ({cfg.digest()}) — artifact corrupt or "
+            "tampered"
+        )
+    want = servable_digest(cfg.digest(), manifest["step"])
+    if manifest.get("delta_digest") != want:
+        raise ValueError(
+            f"{directory}: delta_digest {manifest.get('delta_digest')!r}"
+            f" does not match servable identity {want} for step "
+            f"{manifest['step']} — artifact corrupt or tampered"
+        )
+    return manifest
+
+
+def apply_delta(engine, directory: str):
+    """Fold a delta onto ``engine``'s servable and return a NEW
+    engine at the delta's step.
+
+    The returned engine is a :meth:`PredictEngine.clone` with a fresh
+    param-state (shared AOT executables — applying a delta never
+    recompiles; the state is an executable argument) whose tables have
+    the delta rows scattered in place.  The source engine is
+    untouched: fleets canary the new engine through the staged-rollout
+    gate before any traffic converges on it (serve/fleet.py
+    ``rollout_delta``).
+
+    Refusals (all actionable): config-digest mismatch (wrong model),
+    digest-chain mismatch (this delta was cut against a different
+    servable — apply the intervening deltas in order, or load the
+    fresh full base the compaction policy cut), content-sha mismatch
+    (bytes corrupt)."""
+    manifest = load_delta_manifest(directory)
+    if manifest["config_digest"] != engine.digest:
+        raise ValueError(
+            f"delta {directory} was exported from config "
+            f"{manifest['config_digest']}, engine serves "
+            f"{engine.digest} — refusing to apply across models"
+        )
+    base = manifest["base_digest"]
+    if base != engine.servable_digest:
+        raise ValueError(
+            f"digest-chain mismatch: delta {directory} was cut against "
+            f"servable {base} (step {manifest['base_step']}), but the "
+            f"engine currently serves {engine.servable_digest} (step "
+            f"{engine.servable_step}) — apply the intervening deltas "
+            "in export order, or load the newest full base artifact "
+            "(docs/CONTINUOUS.md \"Delta chain\")"
+        )
+    # Load + integrity-check EVERY host array before any device work:
+    # a corrupt delta must cost a sha pass, not a full table scatter
+    # plus device_puts, before refusal.
+    keys = np.load(os.path.join(directory, "delta.keys.npy"))
+    sha = hashlib.sha256()
+    sha.update(np.ascontiguousarray(keys, np.int64).tobytes())
+    table_rows: dict[str, np.ndarray] = {}
+    for tname in sorted(engine.state["tables"]):
+        if manifest["arrays"].get(f"{tname}.param") is None:
+            raise ValueError(
+                f"delta {directory} missing rows for table {tname!r}"
+            )
+        rows = np.load(
+            os.path.join(directory, f"delta.{tname}.param.npy")
+        )
+        sha.update(np.ascontiguousarray(rows, np.float32).tobytes())
+        table_rows[tname] = rows
+    dense_host: dict[str, np.ndarray] = {}
+    for dname in manifest["dense"]:
+        host = np.load(os.path.join(directory, f"dense.{dname}.npy"))
+        sha.update(np.ascontiguousarray(host).tobytes())
+        if dname not in engine.state["dense"]:
+            raise ValueError(
+                f"delta {directory} carries dense array {dname!r} the "
+                "engine does not have — wrong model family"
+            )
+        dense_host[dname] = host
+    if sha.hexdigest() != manifest["content_sha256"]:
+        raise ValueError(
+            f"delta {directory}: content sha mismatch — the delta "
+            "files were corrupted after export; re-export or fall "
+            "back to the newest full base"
+        )
+    new_tables = {}
+    for tname, rows in table_rows.items():
+        param = engine.state["tables"][tname]["param"]
+        if len(keys):
+            param = param.at[jnp.asarray(keys, jnp.int32)].set(
+                jnp.asarray(rows, param.dtype)
+            )
+        new_tables[tname] = {"param": param}
+    new_dense = {
+        dname: jax.device_put(
+            host, engine.state["dense"][dname].sharding
+        )
+        for dname, host in dense_host.items()
+    }
+    out = engine.clone()
+    out.state = {
+        "tables": new_tables,
+        "dense": new_dense,
+        "step": jnp.asarray(manifest["step"], jnp.int32),
+    }
+    out.servable_step = int(manifest["step"])
+    return out

@@ -1,0 +1,462 @@
+"""StreamDriver — the continuous train→export→canary→swap loop.
+
+One single-host process owns the whole loop (the topology the
+reference's online deployments run per model: a trainer pod feeding a
+serving fleet):
+
+    ShardFollower ──batches──▶ Trainer.train_stream
+         │                         │ every export_every_steps
+         │ durable IngestCursor    ▼
+         │                    export_delta (or a full base every
+         │                    compact_every deltas / after an abort)
+         │                         │
+         │                         ▼
+         │                ReplicaFleet.rollout_delta / begin_rollout
+         │                  canary → health gate → commit (or abort)
+         │                         │
+         └── ingest timestamps ───▶ `freshness` row: newest-event-age
+                                    at swap commit (obs/schema.py)
+
+Design points:
+
+* **Single thread of control.**  The driver never spawns threads: the
+  follower is a synchronous generator, rollouts are driven by probe
+  requests submitted inline while polling the gate (real traffic works
+  too — probes just guarantee the canary gate accumulates on an
+  otherwise-idle toy fleet).  Concurrency stays where PR 6/10 already
+  gated it (loader prefetch, batcher workers).
+* **Freshness is measured, not assumed.**  Every batch carries the
+  wall-clock instant its shard appeared; an export records the newest
+  such instant it covers; the ``freshness`` row at commit reports
+  ``now - newest_covered`` — the true event-to-servable latency the
+  SLO is about (docs/CONTINUOUS.md "Freshness SLO").
+* **Abort recovery = compaction.**  A delta whose rollout aborts
+  leaves the fleet on the older servable; the next refresh detects the
+  broken chain (exported step != fleet servable step) and cuts a
+  fresh FULL base instead of wedging on digest-chain refusals.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+
+import numpy as np
+
+import jax
+
+from xflow_tpu.config import Config
+from xflow_tpu.serve.artifact import export_artifact, servable_digest
+from xflow_tpu.serve.fleet import ReplicaFleet, ShedError
+from xflow_tpu.stream.delta import (
+    TouchedLedger,
+    delta_nbytes,
+    export_delta,
+)
+from xflow_tpu.stream.follower import IngestCursor, ShardFollower
+from xflow_tpu.trainer import Trainer
+
+
+class StreamDriver:
+    """``python -m xflow_tpu.stream run`` in library form (the gate
+    script and tests drive it directly)."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        stream_dir: str,
+        workdir: str,
+        *,
+        replicas: int = 2,
+        export_every_steps: int = 20,
+        compact_every: int = 8,
+        canary_frac: float = 0.25,
+        min_canary_requests: int = 16,
+        max_error_frac: float = 0.0,
+        max_p99_ms: float | None = None,
+        freshness_slo_s: float = 60.0,
+        rollout_timeout_s: float = 60.0,
+        probe_batch: int = 8,
+        poll_interval_s: float = 0.25,
+        idle_stop_s: float | None = None,
+        max_steps: int | None = None,
+        max_commits: int | None = None,
+        buckets=(1, 8, 64),
+        resume: str | None = None,
+        log=None,
+    ):
+        if export_every_steps < 1:
+            raise ValueError("export_every_steps must be >= 1")
+        if compact_every < 1:
+            raise ValueError("compact_every must be >= 1")
+        self.cfg = cfg
+        self.stream_dir = stream_dir
+        self.workdir = workdir
+        self.replicas = replicas
+        self.export_every_steps = export_every_steps
+        self.compact_every = compact_every
+        self.canary_frac = canary_frac
+        self.min_canary_requests = min_canary_requests
+        self.max_error_frac = max_error_frac
+        self.max_p99_ms = max_p99_ms
+        self.freshness_slo_s = freshness_slo_s
+        self.rollout_timeout_s = rollout_timeout_s
+        self.probe_batch = probe_batch
+        self.max_steps = max_steps
+        self.max_commits = max_commits
+        self.buckets = tuple(buckets)
+        self._log = log if log is not None else (lambda s: None)
+        os.makedirs(workdir, exist_ok=True)
+        self.trainer = Trainer(cfg)
+        self.cursor = IngestCursor(
+            os.path.join(workdir, "ingest-cursor.json")
+        )
+        # Model-state durability pairs with the ingestion cursor: with
+        # --checkpoint-dir the driver checkpoints at every export cut,
+        # EMBEDDING the cursor snapshot, and a restore rewinds the
+        # cursor file to it — shards trained after the checkpoint
+        # replay on the restored model (at-least-once), and a restart
+        # can never train new shards on fresh weights while the cursor
+        # skips the old ones (docs/CONTINUOUS.md "Cursor & resume").
+        restored = None
+        if resume:
+            restored = self.trainer.restore(auto=(resume == "auto"))
+            if restored is not None:
+                self._log(f"resumed model state at {restored}")
+                snap = restored.get("stream")
+                if snap is not None:
+                    self.cursor.load_payload(snap)
+                    self._log(
+                        f"rewound ingestion cursor to the checkpoint "
+                        f"({len(self.cursor.done)} shard(s) done)"
+                    )
+        if restored is None and (self.cursor.done or self.cursor.current):
+            self._log(
+                "WARNING: the ingestion cursor resumes the stream but "
+                "the MODEL starts fresh — earlier shards' training is "
+                "lost; run with --checkpoint-dir and --resume auto for "
+                "a consistent restart (docs/CONTINUOUS.md)"
+            )
+        self.trainer.register_stream_cursor(self.cursor)
+        self._stop_requested = False
+        self.follower = ShardFollower(
+            stream_dir,
+            self.trainer._loader,
+            self.cursor,
+            poll_interval_s=poll_interval_s,
+            idle_stop_s=idle_stop_s,
+            stop=self._should_stop,
+            obs=self.trainer.obs,
+            io_retries=cfg.io_retries,
+            io_retry_backoff_s=cfg.io_retry_backoff_s,
+        )
+        self.ledger = TouchedLedger()
+        self.fleet: ReplicaFleet | None = None
+        self._newest_ingest = 0.0
+        # step of the newest export on disk vs the step the fleet
+        # actually serves: divergence (an aborted rollout) forces the
+        # next refresh to cut a full base — the chain self-heals
+        self._last_export_step = -1
+        self._fleet_step = -1
+        self.deltas_since_base = 0
+        self._base_steps: list[int] = []
+        self.commits = 0
+        self.aborts = 0
+        self.exports = 0
+        self.probe_errors = 0
+        self._rng = np.random.default_rng(cfg.seed + 1)
+        self._closed = False
+        # the live train_stream generator chain: an early break (max
+        # commits) suspends it mid-shard with the shard file open —
+        # close() shuts it down explicitly instead of waiting on GC
+        # (the Trainer._live_prefetch discipline, generator edition)
+        self._stream_gen = None
+        # test/gate hook: called as on_commit(driver, export_info)
+        # right after a rollout commits, while the trainer state still
+        # sits at the committed step — the parity check's window
+        self.on_commit = None
+
+    # -- control ------------------------------------------------------------
+
+    def request_stop(self) -> None:
+        """Graceful stop (the CLI's SIGTERM/SIGINT hook): the follower
+        returns at its next batch boundary and run() drains."""
+        self._stop_requested = True
+
+    def _should_stop(self) -> bool:
+        if self._stop_requested:
+            return True
+        if (
+            self.max_steps is not None
+            and self.trainer._global_steps >= self.max_steps
+        ):
+            return True
+        if self.max_commits is not None and (
+            self.commits >= self.max_commits
+        ):
+            return True
+        return False
+
+    # -- ingestion tagging --------------------------------------------------
+
+    def _tagged_batches(self):
+        """Follower stream with the driver's two per-batch hooks: the
+        touched-row ledger (delta export) and the newest-event stamp
+        (freshness)."""
+        for batch, meta in self.follower.batches():
+            self.ledger.mark(batch)
+            if meta.ingest_unix > self._newest_ingest:
+                self._newest_ingest = meta.ingest_unix
+            yield batch, meta
+
+    # -- export / rollout ---------------------------------------------------
+
+    def _step_now(self) -> int:
+        return int(jax.device_get(self.trainer.state["step"]))
+
+    def _export_path(self, kind: str, step: int) -> str:
+        return os.path.join(
+            self.workdir, "exports", f"{kind}-{step:010d}"
+        )
+
+    def _cut_export(self) -> dict:
+        """Cut the next servable artifact: an incremental delta when
+        the chain is intact and under the compaction budget, else a
+        full base.  Resets the ledger — an aborted rollout of the
+        result is recovered by the base fallback, never by replaying
+        the ledger."""
+        step = self._step_now()
+        need_base = (
+            self.fleet is None
+            or self.deltas_since_base >= self.compact_every
+            or self._last_export_step != self._fleet_step
+        )
+        newest = self._newest_ingest
+        if need_base:
+            path = self._export_path("base", step)
+            export_artifact(self.trainer, path)
+            self.deltas_since_base = 0
+            self._base_steps.append(step)
+            self._gc_exports()
+            kind = "base"
+            rows = self.cfg.table_size
+        else:
+            path = self._export_path("delta", step)
+            manifest = export_delta(
+                self.trainer, path, self.ledger, self._last_export_step
+            )
+            self.deltas_since_base += 1
+            kind = "delta"
+            rows = manifest["rows"]
+        self.ledger.reset()
+        self._last_export_step = step
+        self.exports += 1
+        if self.cfg.checkpoint_dir:
+            # model durability at export cadence, cursor snapshot
+            # embedded (restore rewinds the stream to this exact point)
+            self.trainer.save(extra={"stream": self.cursor.payload()})
+        info = {
+            "kind": kind,
+            "path": path,
+            "step": step,
+            "rows": int(rows),
+            "bytes": delta_nbytes(path),
+            "newest_ingest": newest,
+            "deltas_since_base": self.deltas_since_base,
+        }
+        self._log(
+            f"export[{self.exports}] {kind} step={step} rows={rows} "
+            f"bytes={info['bytes']}"
+        )
+        self._freshness_row("export", info)
+        return info
+
+    def _gc_exports(self) -> None:
+        """Retention mirroring checkpoint_keep=2 (Config): keep the
+        chains of the newest TWO bases; anything older serves no
+        replayable purpose (a cold start loads the newest base, the
+        previous one is the mid-commit safety margin).  Without this a
+        follow-forever run accumulates GB-scale bases until the disk
+        fills and the export write takes the loop down."""
+        if len(self._base_steps) < 2:
+            return
+        floor = self._base_steps[-2]
+        exp = os.path.join(self.workdir, "exports")
+        for name in os.listdir(exp):
+            try:
+                step = int(name.rsplit("-", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            if step < floor:
+                shutil.rmtree(
+                    os.path.join(exp, name), ignore_errors=True
+                )
+
+    def _ensure_fleet(self, base: dict) -> None:
+        assert base["kind"] == "base"
+        self.fleet = ReplicaFleet.load(
+            base["path"],
+            replicas=self.replicas,
+            buckets=self.buckets,
+            metrics_logger=self.trainer.metrics_logger,
+            flight=self.trainer._flight,
+            warm=True,
+        )
+        self._fleet_step = base["step"]
+        self._log(
+            f"fleet up: {self.replicas} replica(s) on servable "
+            f"{self.fleet.servable}"
+        )
+        self._freshness_row("commit", base)
+
+    def _probe_keys(self):
+        n = int(self._rng.integers(1, max(2, self.cfg.max_nnz // 4)))
+        return self._rng.integers(
+            0, self.cfg.table_size, size=n, dtype=np.int64
+        )
+
+    def _drive_rollout(self, info: dict) -> bool:
+        """Roll ``info``'s artifact onto the fleet through the canary
+        health gate, feeding probe traffic while polling; returns True
+        on commit.  A gate that cannot pass within
+        ``rollout_timeout_s`` aborts — the fleet stays on the
+        incumbent and the next refresh cuts a base."""
+        fleet = self.fleet
+        gate = dict(
+            canary_frac=self.canary_frac,
+            min_canary_requests=self.min_canary_requests,
+            max_error_frac=self.max_error_frac,
+            max_p99_ms=self.max_p99_ms,
+        )
+        if info["kind"] == "delta":
+            fleet.rollout_delta(info["path"], **gate)
+        else:
+            fleet.begin_rollout(info["path"], **gate)
+        deadline = time.monotonic() + self.rollout_timeout_s
+        committed = False
+        while True:
+            futs = []
+            for _ in range(self.probe_batch):
+                try:
+                    futs.append(fleet.submit(self._probe_keys()))
+                except ShedError:
+                    pass  # admission control defending the budget
+            for f in futs:
+                try:
+                    f.result(timeout=30.0)
+                except Exception:  # booked by the fleet's own counters
+                    self.probe_errors += 1
+            state = fleet.rollout_state()
+            if state is None:
+                # resolved underneath us (a concurrent auto tick or an
+                # operator commit/abort): only the servable identity
+                # says WHICH way — an external abort must not book a
+                # commit (the digest-chain would silently break)
+                committed = fleet.servable == servable_digest(
+                    fleet.digest, info["step"]
+                )
+                break
+            if state["healthy"]:
+                health = fleet.commit_rollout()
+                self._log(f"rollout commit: {health}")
+                committed = True
+                break
+            if time.monotonic() > deadline:
+                health = fleet.abort_rollout(
+                    detail="stream driver: health gate timeout"
+                )
+                self._log(f"rollout ABORT (gate timeout): {health}")
+                break
+        if committed:
+            self.commits += 1
+            self._fleet_step = info["step"]
+            self._freshness_row("commit", info)
+            if self.on_commit is not None:
+                self.on_commit(self, info)
+        else:
+            self.aborts += 1
+            self._freshness_row("abort", info)
+        return committed
+
+    def _freshness_row(self, event: str, info: dict) -> None:
+        """The event-to-servable metric (obs/schema.py ``freshness``):
+        at commit, ``newest_event_age_s`` is wall-clock now minus the
+        newest ingest instant the swapped servable covers — the
+        latency an advertiser's newest click waited to influence live
+        scores."""
+        logger = self.trainer.metrics_logger
+        if logger is None:
+            return
+        age = max(0.0, time.time() - info["newest_ingest"]) if (
+            info["newest_ingest"] > 0
+        ) else 0.0
+        logger.log("freshness", {
+            "event": event,
+            "newest_event_age_s": round(age, 3),
+            "slo_s": round(self.freshness_slo_s, 3),
+            "servable": (
+                self.fleet.servable if self.fleet is not None else "?"
+            ),
+            "export_kind": info["kind"],
+            "step": int(info["step"]),
+            "rows": int(info["rows"]),
+            "delta_bytes": int(info["bytes"]),
+            "deltas_since_base": int(info["deltas_since_base"]),
+        })
+
+    # -- the loop -----------------------------------------------------------
+
+    def run(self) -> dict:
+        """Run the continuous loop until the stop/idle condition;
+        returns a summary dict (the gate script's surface)."""
+        try:
+            self._stream_gen = self.trainer.train_stream(
+                self._tagged_batches()
+            )
+            for steps, _meta in self._stream_gen:
+                if steps % self.export_every_steps:
+                    continue
+                info = self._cut_export()
+                if self.fleet is None:
+                    self._ensure_fleet(info)
+                    continue
+                self._drive_rollout(info)
+                if self._should_stop():
+                    break
+            return self.summary()
+        finally:
+            self.close()
+
+    def summary(self) -> dict:
+        out = {
+            "steps": self.trainer._global_steps,
+            "shards_ingested": self.follower.shards_ingested,
+            "exports": self.exports,
+            "commits": self.commits,
+            "aborts": self.aborts,
+            "probe_errors": self.probe_errors,
+            "servable": (
+                self.fleet.servable if self.fleet is not None else None
+            ),
+        }
+        if self.fleet is not None:
+            out["fleet"] = self.fleet.stats()
+        return out
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._stream_gen is not None:
+            self._stream_gen.close()  # releases the open shard file
+            self._stream_gen = None
+        if self.fleet is not None:
+            self.fleet.close()
+        self.trainer.close()
+
+    def __enter__(self) -> "StreamDriver":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,278 @@
+"""ShardFollower — streaming ingestion over a growing shard directory.
+
+The batch loader (io/loader.py) walks a FIXED shard list per epoch; a
+continuous trainer instead *tails* a directory that another process
+keeps appending packed-v2 shards to.  Two contracts make that safe:
+
+* **Presence == complete.**  Every writer in this repo (io/packed.py
+  ``write_shard``/``write_shard_v2``, io/binary.py, checkpoints)
+  writes to a ``*.tmp.*`` name and ``os.replace``s on finalize, so a
+  directory listing can never surface a half-written shard.  The
+  follower additionally skips any name containing ``.tmp`` — a foreign
+  writer that parks temp files next to the stream never feeds the
+  trainer garbage.
+* **Durable ingestion cursor, at-least-once.**  The
+  :class:`IngestCursor` records finished shard names plus the
+  (current shard, byte offset) position, flushed through the same
+  atomic tmp + ``os.replace`` discipline as checkpoints — at every
+  shard boundary and by ``Trainer.close()`` (preemption path).  A
+  restart resumes exactly where the cursor says; a hard kill between
+  shard-complete and cursor-write replays AT MOST ONE SHARD (the
+  at-least-once contract, docs/CONTINUOUS.md "Cursor & resume").
+  FTRL/SGD updates are not idempotent under replay, so the replayed
+  shard trains twice — bounded, loud (the cursor logs the rewind),
+  and the price of never *skipping* data.
+
+Each batch is stamped with the wall-clock instant its shard was first
+observed (``StreamMeta.ingest_unix``) — the event-time anchor behind
+the ``freshness`` metric (newest-event-age at swap commit).
+
+Self-healing: the directory poll rides the ``stream.poll`` chaos
+failpoint + bounded retry (chaos/heal.py — ``recovered:io_retry``
+health rows); per-record corruption inside a shard rides the loader's
+own quarantine/retry fabric unchanged (ShardLoader is the reader).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Callable, Iterator
+
+from xflow_tpu.chaos import failpoint, retry_call
+from xflow_tpu.obs import NULL_OBS
+
+
+@dataclasses.dataclass
+class StreamMeta:
+    """Per-batch ingestion provenance, yielded alongside every batch."""
+
+    shard: str  # shard file name (basename, the cursor's key)
+    resume_offset: int  # loader resume offset AFTER this batch
+    ingest_unix: float  # when the shard was first observed
+    shard_index: int  # 0-based ingestion order across the stream
+
+
+class IngestCursor:
+    """Durable stream position: finished shard names + (current shard,
+    offset).  ``flush()`` is atomic (tmp + ``os.replace`` — the
+    checkpoint discipline); callers flush at shard boundaries and on
+    ``Trainer.close()``, which bounds replay after a hard kill to one
+    shard (at-least-once)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.done: set[str] = set()
+        self.current: str | None = None
+        self.offset: int = 0
+        self._dirty = False
+        if os.path.exists(path):
+            with open(path) as f:
+                raw = json.load(f)
+            self.done = set(raw.get("done", []))
+            self.current = raw.get("current")
+            self.offset = int(raw.get("offset", 0))
+
+    def note(self, shard: str, offset: int) -> None:
+        """In-memory position update (one per yielded batch — cheap);
+        durability happens at flush()."""
+        self.current = shard
+        self.offset = int(offset)
+        self._dirty = True
+
+    def mark_done(self, shard: str) -> None:
+        self.done.add(shard)
+        if self.current == shard:
+            self.current = None
+            self.offset = 0
+        self._dirty = True
+
+    def payload(self) -> dict:
+        """JSON-ready snapshot — embedded into trainer checkpoints so
+        a restored model rewinds the cursor to ITS stream position
+        (stream/driver.py): model state and ingestion position move as
+        one, or replay is unbounded/skipping (docs/CONTINUOUS.md)."""
+        return {
+            "done": sorted(self.done),
+            "current": self.current,
+            "offset": self.offset,
+        }
+
+    def load_payload(self, payload: dict) -> None:
+        """Rewind/replace the cursor from a checkpoint snapshot and
+        persist it — shards trained after the checkpoint REPLAY on the
+        restored model (at-least-once, never skip)."""
+        self.done = set(payload.get("done", []))
+        self.current = payload.get("current")
+        self.offset = int(payload.get("offset", 0))
+        self._dirty = True
+        self.flush()
+
+    def flush(self) -> None:
+        """Atomic durable write — same tmp + ``os.replace`` path as
+        checkpoints (utils/checkpoint.py), so a kill mid-flush leaves
+        the previous cursor intact, never a torn file."""
+        if not self._dirty:
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        payload = self.payload()
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._dirty = False
+
+
+class ShardFollower:
+    """Tail ``directory`` for complete shard files and stream their
+    batches through ``loader_factory`` (a ``path -> ShardLoader``
+    callable, so the follower inherits the loader's quarantine/retry
+    healing and format sniffing — text, CSR-binary, packed v1/v2).
+
+    Synchronous by design: ``batches()`` is a plain generator the
+    training loop drains — no threads, no queues, no shared state
+    (the trainer's own prefetch/transfer machinery stays the
+    concurrency layer).  Files are consumed in NAME order; writers
+    must use monotonically sortable names (the ``prefix-NNNNN``
+    convention already does).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        loader_factory: Callable,
+        cursor: IngestCursor,
+        poll_interval_s: float = 0.5,
+        idle_stop_s: float | None = None,
+        stop: Callable[[], bool] | None = None,
+        obs=None,
+        io_retries: int = 2,
+        io_retry_backoff_s: float = 0.05,
+    ):
+        if poll_interval_s <= 0:
+            raise ValueError("poll_interval_s must be > 0")
+        self.directory = directory
+        self.loader_factory = loader_factory
+        self.cursor = cursor
+        self.poll_interval_s = poll_interval_s
+        # stop after this much continuous idle (no new complete shards);
+        # None = follow forever (production tail mode)
+        self.idle_stop_s = idle_stop_s
+        self._stop = stop if stop is not None else lambda: False
+        self.obs = obs if obs is not None else NULL_OBS
+        self.io_retries = io_retries
+        self.io_retry_backoff_s = io_retry_backoff_s
+        # shard -> first-observed wall clock (the event-time anchor);
+        # shards already finished per the cursor never re-enter, so
+        # this map is bounded by the in-flight window
+        self._first_seen: dict[str, float] = {}
+        self.shards_ingested = 0
+        self.polls = 0
+
+    # -- discovery ----------------------------------------------------------
+
+    def _poll_once(self) -> list[str]:
+        """One directory listing through the chaos + retry fabric.
+        ``stream.poll`` is the injection site (scripts/check_chaos.py
+        grammar); a transient listing failure heals with a bounded
+        retry and a ``recovered:io_retry`` health row — a persistent
+        one propagates (the stream source is gone, which is not a
+        skippable fault)."""
+
+        def attempt() -> list[str]:
+            failpoint("stream.poll")
+            names = []
+            for name in os.listdir(self.directory):
+                if ".tmp" in name:
+                    continue  # writer scratch — never complete
+                if not os.path.isfile(os.path.join(self.directory, name)):
+                    continue
+                names.append(name)
+            return sorted(names)
+
+        return retry_call(
+            attempt,
+            attempts=self.io_retries,
+            backoff_s=self.io_retry_backoff_s,
+            channel="stream",
+            site=f"poll:{self.directory}",
+            obs=self.obs,
+        )
+
+    def pending_shards(self) -> list[str]:
+        """Complete shards not yet fully ingested, in consumption
+        order (cursor's current shard first when resuming)."""
+        self.polls += 1
+        names = self._poll_once()
+        now = time.time()
+        out = []
+        for name in names:
+            if name in self.cursor.done:
+                continue
+            self._first_seen.setdefault(name, now)
+            out.append(name)
+        return out
+
+    # -- streaming ----------------------------------------------------------
+
+    def batches(self) -> Iterator[tuple]:
+        """Yield ``(batch, StreamMeta)`` forever (or until the stop/
+        idle condition): drain every pending shard in order, then poll
+        again.  The cursor advances in memory per batch and flushes
+        durably per finished shard."""
+        idle_since: float | None = None
+        while True:
+            if self._stop():
+                return
+            pending = self.pending_shards()
+            if not pending:
+                now = time.time()
+                if idle_since is None:
+                    idle_since = now
+                if (
+                    self.idle_stop_s is not None
+                    and now - idle_since >= self.idle_stop_s
+                ):
+                    return
+                time.sleep(self.poll_interval_s)
+                continue
+            idle_since = None
+            for name in pending:
+                if self._stop():
+                    return
+                yield from self._ingest_shard(name)
+
+    def _ingest_shard(self, name: str) -> Iterator[tuple]:
+        path = os.path.join(self.directory, name)
+        start = (
+            self.cursor.offset if self.cursor.current == name else 0
+        )
+        loader = self.loader_factory(path)
+        ingest_unix = self._first_seen.get(name, time.time())
+        index = self.shards_ingested
+        for batch, resume in loader.iter_batches(start):
+            yield batch, StreamMeta(
+                shard=name,
+                resume_offset=resume,
+                ingest_unix=ingest_unix,
+                shard_index=index,
+            )
+            # the cursor advances only HERE — at generator resumption,
+            # i.e. after the consumer came back for the next batch, so
+            # the yielded one was trained.  A dispatch that raises
+            # never resumes this generator, the cursor stays on the
+            # previous batch, and the close()-path flush replays the
+            # failed batch instead of skipping it (at-least-once).
+            self.cursor.note(name, resume)
+        self.shards_ingested += 1
+        self._first_seen.pop(name, None)
+        self.cursor.mark_done(name)
+        # durable at every shard boundary: the at-least-once bound —
+        # a kill right here (after training, before the flush) replays
+        # exactly this one shard on restart
+        self.cursor.flush()

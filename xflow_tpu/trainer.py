@@ -104,6 +104,13 @@ class Trainer:
         # close() reaps them explicitly (XF006 — the _PrefetchIter leak
         # class, executor edition).
         self._live_transfer: set = set()
+        # Continuous-training ingestion cursor (stream/follower.py::
+        # IngestCursor), registered by the stream driver: close()
+        # flushes it through the cursor's own atomic tmp+os.replace
+        # path — the same discipline as checkpoints — so a preemption
+        # between shard-complete and cursor-write replays at most one
+        # shard (the at-least-once contract, docs/CONTINUOUS.md).
+        self._stream_cursor = None
         # Observability (obs/__init__.py): a live tracer/registry bundle
         # when metrics or tracing is requested, else the shared no-op
         # NULL_OBS (zero per-step allocation).  Threaded into the step
@@ -315,6 +322,16 @@ class Trainer:
             # worker (bounded join; a leak lands as a health row before
             # the metrics logger closes below)
             self.step.store.close()
+        if self._stream_cursor is not None:
+            # durable ingestion position on EVERY exit road (the
+            # checkpoint discipline): a graceful preemption mid-shard
+            # resumes at the exact batch offset; only a hard kill
+            # falls back to the shard-boundary flush (<= 1 shard
+            # replayed — at-least-once, docs/CONTINUOUS.md)
+            try:
+                self._stream_cursor.flush()
+            except OSError as e:
+                self._log(f"stream cursor flush failed: {e}")
         if (
             self._flight is not None
             and self._flight_reason is not None
@@ -1027,6 +1044,60 @@ class Trainer:
             return lambda: None
         return restore
 
+    # -- continuous training (stream/; docs/CONTINUOUS.md) -----------------
+
+    def register_stream_cursor(self, cursor) -> None:
+        """Attach a stream ingestion cursor (stream/follower.py::
+        IngestCursor) so close() flushes it durably on every exit road
+        — crash, preemption, normal return."""
+        self._stream_cursor = cursor
+
+    def train_stream(self, batches) -> Iterator[tuple[int, Any]]:
+        """Iterator-driven training for the continuous loop: consume
+        ``(batch, meta)`` pairs (stream/follower.py ShardFollower) and
+        dispatch one train step each, yielding ``(steps_so_far, meta)``
+        AFTER the step so the driver can cut delta exports / drive
+        rollouts between steps against a consistent state.
+
+        Phase accounting, heartbeats, and store maintenance match
+        train_epoch's hot loop; epoch semantics (multi-host shard
+        voting, the transfer-ahead ring) deliberately do not apply —
+        the stream is unbounded and single-host by construction (the
+        continuous driver's topology, stream/driver.py)."""
+        if self.num_hosts > 1:
+            raise RuntimeError(
+                "train_stream is single-host: continuous ingestion has "
+                "no shard-count voting (docs/CONTINUOUS.md)"
+            )
+        cfg = self.cfg
+        obs = self.obs
+        steps = 0
+        it = iter(batches)
+        while True:
+            t_step = time.perf_counter()
+            self._pulse("input_stall")
+            with obs.phase("input_stall"):
+                try:
+                    batch, meta = next(it)
+                except StopIteration:
+                    break
+            self._pulse("dispatch")
+            arrays = self.step.put_batch(batch)
+            self.state, _ = self.step.dispatch_train(self.state, arrays)
+            obs.observe("step_seconds", time.perf_counter() - t_step)
+            steps += 1
+            self._global_steps += 1
+            if self.step.store is not None and (
+                steps % cfg.store_promote_every == 0
+            ):
+                self.state = self.step.store.maintain(self.state, obs=obs)
+            yield steps, meta
+        if self.step.store is not None:
+            # stream-end flush: the last step's miss write-back must
+            # land before any export reads the cold store
+            self.state = self.step.store.maintain(self.state, obs=obs)
+        self._pulse("idle")
+
     # -- evaluation --------------------------------------------------------
 
     def evaluate(self, pred_out: str | None = None) -> dict:
@@ -1178,7 +1249,17 @@ class Trainer:
 
     # -- checkpointing -----------------------------------------------------
 
-    def save(self, shard_idx: int = 0, offset: int = 0) -> str | None:
+    def save(
+        self,
+        shard_idx: int = 0,
+        offset: int = 0,
+        extra: dict | None = None,
+    ) -> str | None:
+        """``extra`` merges additional keys into the manifest's cursor
+        dict — the continuous driver embeds the stream ingestion
+        cursor snapshot there (``{"stream": ...}``) so restore() hands
+        it back and model state + stream position rewind together
+        (docs/CONTINUOUS.md)."""
         if not self.cfg.checkpoint_dir:
             return None
         self._pulse("checkpoint")
@@ -1206,6 +1287,8 @@ class Trainer:
             "shard": cursors[0]["shard"],
             "offset": cursors[0]["offset"],
         }
+        if extra:
+            cursor.update(extra)
         if self.step.store is not None:
             # tier-erased fold (store/tiered.py): touched rows from
             # BOTH tiers, key-sorted, in the row-range shard format
