@@ -26,11 +26,17 @@ from bench import (  # noqa: E402
 
 
 def model_cfgs(base_b: int, accel: bool):
-    """(name, Config) per family.  FM/MVM: v_dim=10 (ftrl.h:16).  FFM:
-    per-field latent D=4.  max_fields=39 everywhere — the bench data is
-    Criteo-shaped with fgids 0..38 (gen_synth.FIELDS); a smaller cap
-    would silently mask fields out of the field-aware models.  Sizes
-    shrink on the CPU fallback to keep runtime bounded.
+    """(name, Config) per family, enumerated from the MODEL REGISTRY
+    (models/__init__.py) — every registered family MUST have a bench
+    geometry below, so a new family is throughput-tracked (and gated
+    by check_bench_regress.py) from the day it registers, or this
+    script fails loudly instead of silently skipping it.
+
+    FM/MVM: v_dim=10 (ftrl.h:16).  FFM: per-field latent D=4.
+    max_fields=39 everywhere — the bench data is Criteo-shaped with
+    fgids 0..38 (gen_synth.FIELDS); a smaller cap would silently mask
+    fields out of the field-aware models.  Sizes shrink on the CPU
+    fallback to keep runtime bounded.
 
     Hot geometries are the measured per-model optima (docs/PERF.md
     round-4 sweeps).  The wide-row models (FM/MVM, D=10) profit from a
@@ -42,8 +48,14 @@ def model_cfgs(base_b: int, accel: bool):
     FFM's table rows are max_fields*v_dim = 156 floats wide — at
     T=2^24 the (param, n, z) triple would be ~31 GB; its natural
     single-chip scale is T=2^21 (3.9 GB).  No hot table: h2*D = 9984
-    lanes would force tiny scan chunks through ops/hot.py."""
+    lanes would force tiny scan chunks through ops/hot.py.
+
+    two_tower/dcn (the cascade families, docs/SERVING.md): the same
+    embedding-tower geometry as wide_deep (E=8 over 39 fields) so
+    their rows read against its trajectory; two_tower splits the 39
+    fields 20 user / 19 item."""
     from xflow_tpu.config import Config
+    from xflow_tpu.models import model_names
 
     t = 24 if accel else 20
     b = base_b if accel else min(base_b, 16384)
@@ -52,25 +64,66 @@ def model_cfgs(base_b: int, accel: bool):
         max_fields=39,
     )
     hot = dict(max_nnz=12, hot_size_log2=14, hot_nnz=32)
-    return [
+    geometries = {
         # flagship geometry (docs/PERF.md round-4 sweep)
-        ("lr", Config(model="lr", max_nnz=16, hot_size_log2=12,
-                      hot_nnz=32, **common)),
-        ("lr_nohot", Config(model="lr", max_nnz=40, **common)),
-        ("fm", Config(model="fm", v_dim=10, **hot, **common)),
-        ("fm_nohot", Config(model="fm", max_nnz=40, v_dim=10, **common)),
-        ("mvm", Config(model="mvm", v_dim=10, **hot, **common)),
-        ("mvm_nohot", Config(model="mvm", max_nnz=40, v_dim=10, **common)),
+        "lr": [
+            ("lr", Config(model="lr", max_nnz=16, hot_size_log2=12,
+                          hot_nnz=32, **common)),
+            ("lr_nohot", Config(model="lr", max_nnz=40, **common)),
+        ],
+        "fm": [
+            ("fm", Config(model="fm", v_dim=10, **hot, **common)),
+            ("fm_nohot", Config(model="fm", max_nnz=40, v_dim=10,
+                                **common)),
+        ],
+        "mvm": [
+            ("mvm", Config(model="mvm", v_dim=10, **hot, **common)),
+            ("mvm_nohot", Config(model="mvm", max_nnz=40, v_dim=10,
+                                 **common)),
+        ],
         # microbatch=4: FFM's [B/s, K, F*D] pair tensors are the live
         # memory; gradient accumulation runs full-size batches at 1/4
         # the intermediates (and measures FASTER than B=32768 whole)
-        ("ffm", Config(model="ffm", max_nnz=40, ffm_v_dim=4, microbatch=4,
-                       **{**common, "table_size_log2": 21 if accel else 18})),
-        ("wide_deep", Config(model="wide_deep", emb_dim=8,
-                             hidden_dim=64, **hot, **common)),
-        ("wide_deep_nohot", Config(model="wide_deep", max_nnz=40, emb_dim=8,
-                                   hidden_dim=64, **common)),
-    ]
+        "ffm": [
+            ("ffm", Config(model="ffm", max_nnz=40, ffm_v_dim=4,
+                           microbatch=4,
+                           **{**common,
+                              "table_size_log2": 21 if accel else 18})),
+        ],
+        "wide_deep": [
+            ("wide_deep", Config(model="wide_deep", emb_dim=8,
+                                 hidden_dim=64, **hot, **common)),
+            ("wide_deep_nohot", Config(model="wide_deep", max_nnz=40,
+                                       emb_dim=8, hidden_dim=64,
+                                       **common)),
+        ],
+        "two_tower": [
+            ("two_tower", Config(model="two_tower", max_nnz=40, emb_dim=8,
+                                 hidden_dim=64, tower_dim=16,
+                                 tower_split_field=20, **common)),
+        ],
+        "dcn": [
+            ("dcn", Config(model="dcn", max_nnz=40, emb_dim=8,
+                           hidden_dim=64, cross_layers=2, **common)),
+        ],
+    }
+    missing = [n for n in model_names() if n not in geometries]
+    if missing:
+        raise SystemExit(
+            f"bench_models: registered famil{'ies' if len(missing) > 1 else 'y'} "
+            f"{missing} have no bench geometry — add one above so "
+            "check_bench_regress.py tracks them from day one"
+        )
+    stale = [n for n in geometries if n not in model_names()]
+    if stale:
+        # the reverse direction: a geometry whose family was renamed
+        # or removed must fail as loudly as a missing one, not rot as
+        # silently-unbenched dead code
+        raise SystemExit(
+            f"bench_models: geometry entr{'ies' if len(stale) > 1 else 'y'} "
+            f"{stale} match no registered family — rename or delete"
+        )
+    return [row for name in model_names() for row in geometries[name]]
 
 
 def run_one(name: str, args) -> None:

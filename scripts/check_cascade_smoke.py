@@ -1,0 +1,352 @@
+"""Cascade smoke lint: train both stages on toy data, export, serve
+the retrieval→ranking cascade over HTTP, drive a zipf mix of
+single-row and top-k traffic, and validate everything the cascade tier
+promises (docs/SERVING.md "Retrieval→ranking cascade"):
+
+* **top-k parity** — the engine's AOT dot-scan + device top-k matches
+  a numpy full-scan argsort over the same user embeddings at 1e-6;
+* **zero fleet-wide recompiles** — after warm, mixed single-row
+  (/v1/score_packed on the ranking fleet) and top-k (/v1/recommend
+  through the cascade) traffic adds no compiled executables on either
+  stage;
+* **0 errors** — every offered request answers 200 with a full
+  k-candidate slate (no starvation on a k <= index-size setup);
+* **independent staged rollout** — the ranking stage canaries and
+  commits a rollout through the existing gate while the retrieval
+  stage serves untouched;
+* **schema** — the emitted metrics JSONL (run_start / serve_load /
+  cascade / serve_stats / serve_shed / rollout) passes obs/schema.py
+  strictly, and `obs doctor` raises no cascade warn on the healthy
+  stream.
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python scripts/check_cascade_smoke.py
+
+Wired into tier-1 via tests/test_cascade.py::test_check_cascade_smoke_script,
+like check_serve_smoke.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+K = 5  # candidates per cascade request
+TOPK_K = 8  # compiled top-k width
+BUCKETS = (8, 64)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import http.client
+
+    import numpy as np
+
+    from tests.gen_data import generate_dataset
+    from xflow_tpu.config import Config
+    from xflow_tpu.io.batch import pad_batch_rows
+    from xflow_tpu.io.loader import make_parse_fn
+    from xflow_tpu.obs.doctor import diagnose
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.serve.artifact import export_artifact, export_item_index
+    from xflow_tpu.serve.cascade import CascadeEngine
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.loadgen import zipf_rows
+    from xflow_tpu.serve.server import (
+        ServeTier,
+        decode_packed_response,
+        encode_packed_request,
+    )
+    from xflow_tpu.trainer import Trainer
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    errors: list[str] = []
+    with tempfile.TemporaryDirectory() as root:
+        ds = generate_dataset(
+            os.path.join(root, "data"),
+            num_train_shards=2,
+            lines_per_shard=150,
+            num_fields=10,
+            vocab_per_field=8,
+            seed=7,
+            scale=3.0,
+        )
+        common = dict(
+            train_path=ds.train_prefix,
+            test_path=ds.test_prefix,
+            epochs=1,
+            batch_size=64,
+            table_size_log2=14,
+            max_nnz=24,
+            max_fields=10,
+            num_devices=1,
+        )
+        # -- stage 1: two-tower retrieval + item index ------------------
+        rcfg = Config(
+            model="two_tower", tower_split_field=5, tower_dim=8, **common
+        )
+        rtr = Trainer(rcfg)
+        rtr.train()
+        rart = export_artifact(rtr, os.path.join(root, "retrieval"))
+        # item catalog + user rows from parsed test lines: item-side
+        # features are slots >= split, user-side slots < split — the
+        # same hashed key space training used.  The catalog comes from
+        # the SHARED identity rule (serve/artifact.py::
+        # item_catalog_from_block — also the `serve index` CLI's), so
+        # this gate exercises exactly what the shipped tool builds.
+        from xflow_tpu.serve.artifact import item_catalog_from_block
+
+        parse = make_parse_fn(
+            rcfg.table_size, rcfg.hash_mode, rcfg.seed, prefer_native=False
+        )
+        with open(ds.test_prefix + "-00000", "rb") as f:
+            block = parse(f.read())
+        items = item_catalog_from_block(block, rcfg.tower_split_field)
+        user_rows = []
+        for i in range(min(8, block.num_samples)):
+            lo, hi = int(block.row_ptr[i]), int(block.row_ptr[i + 1])
+            ks = block.keys[lo:hi].astype(np.int64)
+            ss = block.slots[lo:hi].astype(np.int32)
+            sel = ss < rcfg.tower_split_field
+            user_rows.append((ks[sel], ss[sel], None))
+        export_item_index(
+            PredictEngine.load(rart, warm=False, buckets=BUCKETS),
+            rart,
+            items,
+        )
+        # -- top-k parity: device scan vs numpy full-scan argsort -------
+        eng = PredictEngine.load(
+            rart, warm=True, buckets=BUCKETS, topk_k=TOPK_K
+        )
+        n = len(user_rows)
+        prepared = pad_batch_rows(
+            eng._prepare(eng.featurize_raw(user_rows)), eng.bucket_for(n)
+        )
+        ids, scores, u = eng.topk_prepared(prepared)
+        ids, scores, u = ids[:n], scores[:n], u[:n]
+        full = u @ eng.item_index["item_index"].T  # numpy full scan
+        ref_order = np.argsort(-full, axis=1, kind="stable")[:, :TOPK_K]
+        ref_ids = eng.item_index["item_ids"][ref_order]
+        ref_scores = np.take_along_axis(full, ref_order, axis=1)
+        if np.abs(ref_scores - scores).max() > 1e-6:
+            errors.append(
+                "top-k parity: device scores differ from the numpy "
+                f"full scan by {np.abs(ref_scores - scores).max()}"
+            )
+        # id sets must match per row (ties may order differently, so
+        # compare as sets where scores tie, exact where they don't)
+        for r in range(n):
+            if set(ids[r]) != set(ref_ids[r]) and not np.allclose(
+                scores[r], ref_scores[r], atol=1e-6
+            ):
+                errors.append(f"top-k parity: row {r} id set mismatch")
+        # -- stage 2: dcn ranker ----------------------------------------
+        kcfg = Config(model="dcn", **common)
+        ktr = Trainer(kcfg)
+        ktr.train()
+        kart = export_artifact(ktr, os.path.join(root, "ranking"))
+
+        # -- C-ABI surface: the new families point-score through
+        # capi_impl (registry-routed — an unknown family would refuse
+        # with the registered-families list); top-k stays RPC-only
+        from xflow_tpu import capi_impl
+
+        with open(ds.test_prefix + "-00000") as f:
+            line = f.readline().strip()
+        for art in (rart, kart):
+            capi_engine = capi_impl.engine_create(art)
+            p = capi_impl.engine_score_line(capi_engine, line)
+            if not 0.0 <= p <= 1.0:
+                errors.append(f"capi engine_score_line({art}) gave {p}")
+
+        # -- cascade tier over HTTP -------------------------------------
+        metrics = os.path.join(root, "cascade.jsonl")
+        logger = MetricsLogger(metrics, run_header={
+            "run_id": "cascade-smoke",
+            "config_digest": "smoke",
+            "rank": 0,
+            "num_hosts": 1,
+        })
+        # generous admission budgets: a CPU toy device call is tens of
+        # ms, so production-default deadline budgets would shed this
+        # healthy traffic — the smoke asserts FULL service, and the
+        # shed path has its own coverage (tests/test_serve.py)
+        admission = dict(deadline_budget_ms=5000.0, depth_budget=1024)
+        retrieval = ReplicaFleet.load(
+            rart, replicas=2, buckets=BUCKETS, topk=True, topk_k=TOPK_K,
+            metrics_logger=logger, **admission,
+        )
+        ranking = ReplicaFleet.load(
+            kart, replicas=2, buckets=BUCKETS, metrics_logger=logger,
+            **admission,
+        )
+        retrieval.log_load(rart)
+        ranking.log_load(kart)
+        cascade = CascadeEngine(
+            retrieval, ranking, k=K, metrics_logger=logger
+        )
+        tier = ServeTier(ranking, port=0, cascade=cascade).start()
+        host, port = "127.0.0.1", tier.port
+
+        def fleet_compiles() -> int:
+            return (
+                retrieval.engines[0].compile_count
+                + ranking.engines[0].compile_count
+            )
+
+        compiles_warm = fleet_compiles()
+
+        # -- mixed zipf traffic: single-row scores + cascade top-k ------
+        rng = np.random.default_rng(3)
+        score_rows = zipf_rows(
+            rng, 40, table_size=kcfg.table_size, nnz=8,
+            max_fields=kcfg.max_fields,
+        )
+        rec_rows = [user_rows[i % len(user_rows)] for i in range(20)]
+        fails: list[str] = []
+        lock = threading.Lock()
+        k_returned: list[int] = []
+
+        def post(conn, path, body, ctype):
+            conn.request("POST", path, body=body,
+                         headers={"Content-Type": ctype})
+            r = conn.getresponse()
+            return r.status, r.read()
+
+        def score_worker(rows) -> None:
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            try:
+                for row in rows:
+                    st, payload = post(
+                        conn, "/v1/score_packed",
+                        encode_packed_request([row]),
+                        "application/octet-stream",
+                    )
+                    if st != 200:
+                        with lock:
+                            fails.append(f"score HTTP {st}: {payload[:120]!r}")
+                        continue
+                    decode_packed_response(payload)
+            except Exception as e:
+                with lock:
+                    fails.append(f"score worker: {type(e).__name__}: {e}")
+            finally:
+                conn.close()
+
+        def rec_worker(rows) -> None:
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            try:
+                for keys, slots, _ in rows:
+                    st, payload = post(
+                        conn, "/v1/recommend",
+                        json.dumps({
+                            "keys": [int(x) for x in keys],
+                            "slots": [int(x) for x in slots],
+                            "k": K,
+                        }).encode(),
+                        "application/json",
+                    )
+                    if st != 200:
+                        with lock:
+                            fails.append(f"recommend HTTP {st}: {payload[:120]!r}")
+                        continue
+                    doc = json.loads(payload.decode())
+                    with lock:
+                        k_returned.append(len(doc["items"]))
+            except Exception as e:
+                with lock:
+                    fails.append(f"recommend worker: {type(e).__name__}: {e}")
+            finally:
+                conn.close()
+
+        threads = [
+            threading.Thread(target=score_worker, args=(score_rows[0::2],)),
+            threading.Thread(target=score_worker, args=(score_rows[1::2],)),
+            threading.Thread(target=rec_worker, args=(rec_rows[0::2],)),
+            threading.Thread(target=rec_worker, args=(rec_rows[1::2],)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        errors.extend(fails)
+        if len(k_returned) != len(rec_rows):
+            errors.append(
+                f"only {len(k_returned)}/{len(rec_rows)} recommend "
+                "responses arrived"
+            )
+        if any(n != K for n in k_returned):
+            errors.append(
+                f"candidate starvation: k_returned {sorted(set(k_returned))} "
+                f"!= requested {K}"
+            )
+        if fleet_compiles() != compiles_warm:
+            errors.append(
+                f"fleet-wide recompile under mixed traffic: "
+                f"{compiles_warm} -> {fleet_compiles()}"
+            )
+
+        # -- independent staged rollout of the ranking stage ------------
+        ro = ranking.begin_rollout(kart, canary_frac=0.5,
+                                   min_canary_requests=4)
+        del ro
+        for keys, slots, _ in rec_rows[:8]:
+            cascade.recommend(np.asarray(keys), slots)
+        ranking.commit_rollout()
+        if retrieval.rollout_state() is not None:
+            errors.append("retrieval stage saw the ranking rollout")
+        if fleet_compiles() != compiles_warm:
+            errors.append("rollout of a same-digest artifact recompiled")
+
+        cascade.emit_stats()
+        tier.close()
+        logger.close()
+
+        rows = load_jsonl(metrics)
+        schema_errors = validate_rows(rows)
+        errors.extend(f"schema: {e}" for e in schema_errors)
+        kinds = {r.get("kind") for r in rows}
+        for want in ("cascade", "serve_load", "rollout"):
+            if want not in kinds:
+                errors.append(f"metrics stream missing kind {want!r}")
+        crows = [r for r in rows if r.get("kind") == "cascade"]
+        if not any(int(r.get("requests", 0)) > 0 for r in crows):
+            errors.append("no cascade row with requests > 0")
+        if any(int(r.get("starved", 0)) for r in crows):
+            errors.append("cascade rows report starvation on k <= index")
+        if any(int(r.get("errors", 0)) for r in crows):
+            errors.append("cascade rows report stage errors")
+        bad = [
+            d for d in diagnose(rows)
+            if d.severity in ("crit", "warn")
+            and d.code in ("candidate_starvation", "cascade_errors")
+        ]
+        errors.extend(
+            f"doctor: [{d.severity}] {d.code}: {d.message}" for d in bad
+        )
+
+    if errors:
+        print("check_cascade_smoke: FAIL", file=sys.stderr)
+        for e in errors:
+            print(f"  - {e}", file=sys.stderr)
+        return 1
+    print(
+        "check_cascade_smoke: OK (top-k parity 1e-6, 0 errors, "
+        f"0 recompiles under mixed traffic, {K}-candidate slates, "
+        "ranking rollout committed independently, cascade rows "
+        "schema-valid)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,293 @@
+"""FROZEN pre-refactor model implementations — the bitwise oracles for
+the models/blocks.py refactor (tests/test_models.py no-regression
+pins).
+
+These are verbatim copies of the five incumbent families' forward (and
+explicit gradient) math as they stood BEFORE the logits were expressed
+through models/blocks.py.  They exist so the refactor's
+bitwise-unchanged contract is testable forever: a TrainStep built
+around a legacy model and one built around the refactored model must
+produce np.array_equal pctr on the same state and batch, in dense,
+MXU-hot, and tiered store modes.
+
+DO NOT "clean up" or re-route these through blocks — drifting the
+oracle toward the implementation is exactly the failure mode this file
+exists to prevent.  TableSpecs mirror the live models so init_state
+produces identical tables for either side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.models.base import AutodiffModel, BatchArrays, TableSpec
+
+
+class LegacyLRModel:
+    name = "lr"
+    uses_slots = False
+
+    def tables(self) -> list[TableSpec]:
+        return [TableSpec("w", 1, lambda rng, shape: jnp.zeros(shape, jnp.float32))]
+
+    def logit(self, rows: dict[str, jax.Array], batch: BatchArrays) -> jax.Array:
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        return jnp.sum(rows["w"][..., 0] * x, axis=-1)
+
+    def grad_logit(
+        self, rows: dict[str, jax.Array], batch: BatchArrays
+    ) -> dict[str, jax.Array]:
+        x = batch["vals"] * batch["mask"]
+        return {"w": x[..., None]}
+
+
+@dataclasses.dataclass(frozen=True)
+class LegacyFMModel:
+    v_dim: int = 10
+    v_init_scale: float = 1e-2
+    name: str = "fm"
+    uses_slots = False
+
+    def tables(self) -> list[TableSpec]:
+        return [
+            TableSpec("w", 1, lambda rng, shape: jnp.zeros(shape, jnp.float32)),
+            TableSpec(
+                "v",
+                self.v_dim,
+                lambda rng, shape: (
+                    jax.random.normal(rng, shape, jnp.float32) * self.v_init_scale
+                ),
+                init_kind="normal",
+                init_scale=self.v_init_scale,
+            ),
+        ]
+
+    def _interaction_pieces(
+        self, rows: dict[str, jax.Array], batch: BatchArrays
+    ) -> tuple[jax.Array, jax.Array]:
+        x = (batch["vals"] * batch["mask"])[..., None]  # [B, K, 1]
+        vx = rows["v"] * x  # [B, K, D]
+        sum_vx = jnp.sum(vx, axis=1)  # [B, D]
+        sum_vx2 = jnp.sum(vx * vx, axis=1)  # [B, D]
+        return sum_vx, sum_vx2
+
+    def logit(self, rows: dict[str, jax.Array], batch: BatchArrays) -> jax.Array:
+        x = batch["vals"] * batch["mask"]
+        linear = jnp.sum(rows["w"][..., 0] * x, axis=-1)
+        sum_vx, sum_vx2 = self._interaction_pieces(rows, batch)
+        return linear + jnp.sum(sum_vx * sum_vx - sum_vx2, axis=-1)
+
+    def grad_logit(
+        self, rows: dict[str, jax.Array], batch: BatchArrays
+    ) -> dict[str, jax.Array]:
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        sum_vx, _ = self._interaction_pieces(rows, batch)
+        vx = rows["v"] * x[..., None]
+        grad_v = (sum_vx[:, None, :] - vx) * x[..., None]
+        return {"w": x[..., None], "v": grad_v}
+
+
+_GUARD_EPS = 1e-12
+
+
+@dataclasses.dataclass(frozen=True)
+class LegacyMVMModel:
+    v_dim: int = 10
+    v_init_scale: float = 1e-2
+    max_fields: int = 32
+    name: str = "mvm"
+
+    def tables(self) -> list[TableSpec]:
+        return [
+            TableSpec(
+                "v",
+                self.v_dim,
+                lambda rng, shape: (
+                    jax.random.normal(rng, shape, jnp.float32) * self.v_init_scale
+                ),
+                init_kind="normal",
+                init_scale=self.v_init_scale,
+            )
+        ]
+
+    def _slot_terms(
+        self, rows: dict[str, jax.Array], batch: BatchArrays
+    ) -> tuple[jax.Array, jax.Array]:
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        onehot = jax.nn.one_hot(
+            batch["slots"], self.max_fields, dtype=x.dtype
+        )  # [B, K, S]
+        vx = rows["v"] * x[..., None]  # [B, K, D]
+        slotsum = jnp.einsum("bks,bkd->bsd", onehot, vx)  # [B, S, D]
+        one_plus = 1.0 + slotsum
+        prod = jnp.prod(one_plus, axis=1)  # [B, D]
+        return one_plus, prod
+
+    def logit(self, rows: dict[str, jax.Array], batch: BatchArrays) -> jax.Array:
+        _, prod = self._slot_terms(rows, batch)
+        return jnp.sum(prod - 1.0, axis=-1)
+
+    def grad_logit(
+        self, rows: dict[str, jax.Array], batch: BatchArrays
+    ) -> dict[str, jax.Array]:
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        one_plus, prod = self._slot_terms(rows, batch)
+        slot_idx = jnp.clip(batch["slots"], 0, self.max_fields - 1)  # [B, K]
+        own = jnp.take_along_axis(
+            one_plus,
+            slot_idx[:, :, None],
+            axis=1,
+        )  # [B, K, D]
+        safe = jnp.where(jnp.abs(own) < _GUARD_EPS, 1.0, own)
+        grad_v = jnp.where(
+            jnp.abs(own) < _GUARD_EPS,
+            0.0,
+            prod[:, None, :] / safe,
+        ) * x[..., None]
+        valid = (
+            (batch["slots"] >= 0) & (batch["slots"] < self.max_fields)
+        )[..., None]
+        return {"v": jnp.where(valid, grad_v, 0.0)}
+
+
+@dataclasses.dataclass(frozen=True)
+class LegacyFFMModel(AutodiffModel):
+    v_dim: int = 4
+    max_fields: int = 32
+    v_init_scale: float = 1e-2
+    name: str = "ffm"
+
+    def tables(self) -> list[TableSpec]:
+        return [
+            TableSpec("w", 1, lambda rng, shape: jnp.zeros(shape, jnp.float32)),
+            TableSpec(
+                "v",
+                self.max_fields * self.v_dim,
+                lambda rng, shape: (
+                    jax.random.normal(rng, shape, jnp.float32) * self.v_init_scale
+                ),
+                hot=False,
+                init_kind="normal",
+                init_scale=self.v_init_scale,
+            ),
+        ]
+
+    def logit(
+        self,
+        rows: dict[str, jax.Array],
+        batch: BatchArrays,
+        dense: dict | None = None,
+    ) -> jax.Array:
+        b, k = batch["keys"].shape
+        f, d = self.max_fields, self.v_dim
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        linear = jnp.sum(rows["w"][..., 0] * x, axis=-1)
+
+        valid = (
+            (batch["slots"] >= 0) & (batch["slots"] < f) & (batch["mask"] > 0)
+        )
+        x_eff = jnp.where(valid, x, 0.0)
+        slot = jnp.clip(batch["slots"], 0, f - 1)  # [B, K]
+        onehot = (
+            (slot[:, :, None] == jnp.arange(f)[None, None, :])
+            & valid[:, :, None]
+        ).astype(rows["v"].dtype)  # [B, K, F]
+
+        vx = rows["v"] * x_eff[:, :, None]  # [B, K, E]
+        s = jnp.einsum("bkf,bke->bfe", onehot, vx)  # [B, F, E]
+
+        s4 = s.reshape(b, f, f, d)
+        cross = jnp.sum(
+            s4 * jnp.transpose(s4, (0, 2, 1, 3)), axis=(1, 2, 3)
+        )
+        eslot = (jnp.arange(f * d) // d).astype(slot.dtype)  # [E]
+        emask = eslot[None, None, :] == slot[:, :, None]  # [B, K, E]
+        diag = jnp.sum(jnp.where(emask, vx * vx, 0.0), axis=(1, 2))
+        return linear + 0.5 * (cross - diag)
+
+
+@dataclasses.dataclass(frozen=True)
+class LegacyWideDeepModel(AutodiffModel):
+    emb_dim: int = 8
+    hidden: int = 64
+    max_fields: int = 32
+    v_init_scale: float = 1e-2
+    name: str = "wide_deep"
+
+    def tables(self) -> list[TableSpec]:
+        return [
+            TableSpec("w", 1, lambda rng, shape: jnp.zeros(shape, jnp.float32)),
+            TableSpec(
+                "emb",
+                self.emb_dim,
+                lambda rng, shape: (
+                    jax.random.normal(rng, shape, jnp.float32) * self.v_init_scale
+                ),
+                init_kind="normal",
+                init_scale=self.v_init_scale,
+            ),
+        ]
+
+    def dense_init(self, rng: jax.Array) -> dict:
+        k1, k2 = jax.random.split(rng)
+        in_dim = self.max_fields * self.emb_dim
+        return {
+            "w1": jax.random.normal(k1, (in_dim, self.hidden), jnp.float32)
+            * jnp.sqrt(2.0 / in_dim),
+            "b1": jnp.zeros((self.hidden,), jnp.float32),
+            "w2": jax.random.normal(k2, (self.hidden, 1), jnp.float32)
+            * jnp.sqrt(1.0 / self.hidden),
+            "b2": jnp.zeros((1,), jnp.float32),
+        }
+
+    def logit(
+        self,
+        rows: dict[str, jax.Array],
+        batch: BatchArrays,
+        dense: dict | None = None,
+    ) -> jax.Array:
+        assert dense is not None, "wide_deep requires dense MLP params"
+        x = batch["vals"] * batch["mask"]  # [B, K]
+        wide = jnp.sum(rows["w"][..., 0] * x, axis=-1)
+
+        onehot = jax.nn.one_hot(
+            batch["slots"], self.max_fields, dtype=x.dtype
+        )  # [B, K, F]
+        embx = rows["emb"] * x[..., None]  # [B, K, E]
+        field_emb = jnp.einsum("bkf,bke->bfe", onehot, embx)  # [B, F, E]
+        h = field_emb.reshape(field_emb.shape[0], -1)  # [B, F*E]
+        h = jax.nn.relu(h @ dense["w1"] + dense["b1"])
+        deep = (h @ dense["w2"] + dense["b2"])[:, 0]
+        return wide + deep
+
+
+def legacy_model_for(cfg):
+    """Legacy twin of models.make_model(cfg) for the five incumbent
+    families (the blocks refactor's no-regression scope)."""
+    if cfg.model == "lr":
+        return LegacyLRModel()
+    if cfg.model == "fm":
+        return LegacyFMModel(v_dim=cfg.v_dim, v_init_scale=cfg.v_init_scale)
+    if cfg.model == "mvm":
+        return LegacyMVMModel(
+            v_dim=cfg.v_dim,
+            v_init_scale=cfg.v_init_scale,
+            max_fields=cfg.max_fields,
+        )
+    if cfg.model == "ffm":
+        return LegacyFFMModel(
+            v_dim=cfg.ffm_v_dim,
+            max_fields=cfg.max_fields,
+            v_init_scale=cfg.v_init_scale,
+        )
+    if cfg.model == "wide_deep":
+        return LegacyWideDeepModel(
+            emb_dim=cfg.emb_dim,
+            hidden=cfg.hidden_dim,
+            max_fields=cfg.max_fields,
+            v_init_scale=cfg.v_init_scale,
+        )
+    raise ValueError(f"no legacy oracle for {cfg.model!r}")

@@ -1,11 +1,17 @@
 """Model forward/gradient math vs independent numpy oracles, including
 the reference's FM forward/backward quirk (fm_worker.cc:82 vs :140-142)
-and MVM's fixed consistent 1+sum form (checked against autodiff)."""
+and MVM's fixed consistent 1+sum form (checked against autodiff) —
+plus the models/blocks.py refactor's no-regression contract: every
+incumbent family's predict output bitwise-identical to a frozen copy
+of the pre-refactor implementation (tests/_legacy_models.py) on a
+fixed seeded batch, in dense, MXU-hot, and tiered store modes."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
+from tests._legacy_models import legacy_model_for
 from xflow_tpu.models.fm import FMModel
 from xflow_tpu.models.lr import LRModel
 from xflow_tpu.models.mvm import MVMModel
@@ -127,3 +133,137 @@ def test_mvm_ignores_out_of_range_fields():
     np.testing.assert_array_equal(
         np.asarray(model.grad_logit({"v": v}, batch)["v"]), 0.0
     )
+
+
+# -- blocks refactor: bitwise no-regression vs the frozen legacy oracles ------
+#
+# The refactor's contract (docs/SERVING.md cascade PR): expressing the
+# five incumbent families through models/blocks.py changes NOTHING —
+# not "close", bitwise.  Each family runs the full TrainStep predict
+# machinery twice on one fixed seeded batch and identical state: once
+# with the refactored model, once with the frozen pre-refactor copy
+# (tests/_legacy_models.py), and the pctr arrays must be equal bit for
+# bit, in every parameter-residency mode the step supports.
+
+_NR_FAMILIES = ("lr", "fm", "mvm", "ffm", "wide_deep")
+
+
+def _nr_cfg(name, **over):
+    from xflow_tpu.config import Config
+
+    base = dict(
+        model=name,
+        table_size_log2=10,
+        batch_size=8,
+        max_nnz=6,
+        max_fields=S,
+        num_devices=1,
+    )
+    base.update(over)
+    return Config(**base)
+
+
+def _nr_batch(cfg, seed=11):
+    from xflow_tpu.io.batch import make_batch
+
+    rng = np.random.default_rng(seed)
+    b, k = cfg.batch_size, cfg.max_nnz
+    keys = rng.integers(0, cfg.table_size, (b, k)).astype(np.int32)
+    slots = rng.integers(0, cfg.max_fields, (b, k)).astype(np.int32)
+    vals = np.ones((b, k), np.float32)
+    mask = (rng.random((b, k)) < 0.9).astype(np.float32)
+    labels = rng.integers(0, 2, b).astype(np.float32)
+    weights = np.ones(b, np.float32)
+    return make_batch(
+        keys, slots, vals, mask, labels, weights,
+        cfg.hot_size, cfg.hot_nnz,
+    )
+
+
+def _nr_predict(model, cfg, batch, state=None):
+    from xflow_tpu.optim import make_optimizer
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.parallel.step import TrainStep, init_state
+
+    mesh = make_mesh(1)
+    opt = make_optimizer(cfg)
+    step = TrainStep(model, opt, cfg, mesh)
+    if step.store is not None:
+        state = step.store.init_device_state()
+    elif state is None:
+        state = init_state(model, opt, cfg, mesh)
+    arrays = step.put_batch(batch, predict=True)
+    return np.asarray(jax.device_get(step.predict(state, arrays))), state
+
+
+@pytest.mark.parametrize("name", _NR_FAMILIES)
+def test_blocks_refactor_bitwise_dense(name):
+    from xflow_tpu.models import make_model
+
+    cfg = _nr_cfg(name)
+    batch = _nr_batch(cfg)
+    got, state = _nr_predict(make_model(cfg), cfg, batch)
+    want, _ = _nr_predict(legacy_model_for(cfg), cfg, batch, state=state)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("name", _NR_FAMILIES)
+def test_blocks_refactor_bitwise_hot(name):
+    """MXU-hot mode: frequency-head steering + the hot gather path
+    (seg impl on CPU — gather-exact either way)."""
+    from xflow_tpu.models import make_model
+
+    cfg = _nr_cfg(name, hot_size_log2=6, hot_nnz=4)
+    batch = _nr_batch(cfg)
+    got, state = _nr_predict(make_model(cfg), cfg, batch)
+    want, _ = _nr_predict(legacy_model_for(cfg), cfg, batch, state=state)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("name", _NR_FAMILIES)
+def test_blocks_refactor_bitwise_tiered(name):
+    """Tiered store mode: the hot+miss predict jit (store/hot.py) over
+    a lazily materialized cold store — two independent TieredStores
+    built from the same cfg/seed are deterministic, so the refactored
+    and legacy models must still agree bitwise."""
+    from xflow_tpu.models import make_model
+
+    cfg = _nr_cfg(name, store_mode="tiered", hot_capacity_log2=5)
+    batch = _nr_batch(cfg)
+    got, _ = _nr_predict(make_model(cfg), cfg, batch)
+    want, _ = _nr_predict(legacy_model_for(cfg), cfg, batch)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("name", ("lr", "fm", "mvm"))
+def test_blocks_refactor_bitwise_grads(name):
+    """Explicit-gradient families: grad_logit through blocks is
+    bitwise the pre-refactor gradient (the FM reference-quirk ½-scaled
+    form must survive the refactor exactly)."""
+    from xflow_tpu.models import make_model
+
+    cfg = _nr_cfg(name)
+    new = make_model(cfg)
+    old = legacy_model_for(cfg)
+    rng = np.random.default_rng(5)
+    batch = {
+        "keys": jnp.asarray(rng.integers(0, 100, (B, K)), jnp.int32),
+        "slots": jnp.asarray(rng.integers(0, S, (B, K)), jnp.int32),
+        "vals": jnp.asarray(np.ones((B, K), np.float32)),
+        "mask": jnp.asarray((rng.random((B, K)) < 0.8).astype(np.float32)),
+        "labels": jnp.asarray(rng.integers(0, 2, B).astype(np.float32)),
+        "weights": jnp.ones(B, jnp.float32),
+    }
+    rows = {
+        spec.name: jnp.asarray(
+            rng.normal(size=(B, K, spec.dim)).astype(np.float32)
+        )
+        for spec in new.tables()
+    }
+    g_new = new.grad_logit(rows, batch)
+    g_old = old.grad_logit(rows, batch)
+    assert set(g_new) == set(g_old)
+    for t in g_new:
+        np.testing.assert_array_equal(
+            np.asarray(g_new[t]), np.asarray(g_old[t])
+        )

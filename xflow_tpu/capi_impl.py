@@ -17,6 +17,15 @@ process never builds a loader, optimizer state, or training step.
 Kept deliberately tiny: the C side only imports this module and calls
 these functions, so the ABI never needs to know about Config, Trainer,
 or engine internals.
+
+Model families: every registry family (models/__init__.py — including
+the cascade families ``two_tower``/``dcn``) trains and POINT-SCORES
+through this surface; an unregistered name is refused at create time
+with the registered-families list (the registry's actionable error).
+Top-k retrieval is NOT part of the C ABI: a two_tower artifact scores
+(user, item) rows like any family here, while candidate generation
+lives behind the serving tier's /v1/topk / /v1/recommend endpoints
+(serve/cascade.py) — an RPC surface, not an embed surface.
 """
 
 from __future__ import annotations

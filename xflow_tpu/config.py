@@ -17,9 +17,10 @@ from typing import Any
 @dataclasses.dataclass
 class Config:
     # -- model selection (reference: main.cc:27-45, argv[3] '0'/'1'/'2';
-    # "ffm" and "wide_deep" are capability extensions beyond the
-    # reference's zoo, from BASELINE.json's target configs) --
-    model: str = "lr"  # {"lr", "fm", "mvm", "ffm", "wide_deep"}
+    # everything past lr/fm/mvm is a capability extension).  Valid
+    # names come from the model registry (models/__init__.py) — a new
+    # family registers there once and is config-valid everywhere.
+    model: str = "lr"  # models.model_names()
 
     # -- data (reference: argv[1]/argv[2] shard prefixes, lr_worker.cc:210) --
     train_path: str = ""
@@ -47,9 +48,16 @@ class Config:
     v_dim: int = 10
     # FFM per-field latent dim (its v table is max_fields * ffm_v_dim wide).
     ffm_v_dim: int = 4
-    # Wide&deep embedding dim and hidden layer width.
+    # Wide&deep / two_tower / dcn embedding dim and MLP hidden width.
     emb_dim: int = 8
     hidden_dim: int = 64
+    # two_tower (models/two_tower.py): fields < tower_split_field are
+    # user-side, the rest item-side; tower_dim is each tower's output
+    # (= the serve-time item-index row width, serve/artifact.py).
+    tower_split_field: int = 16
+    tower_dim: int = 16
+    # dcn (models/dcn.py): explicit cross-network depth.
+    cross_layers: int = 2
     # Static padded features-per-sample inside the jit step.  Samples with
     # more features than this are truncated (reference has no limit —
     # features-per-sample is whatever the text line holds).
@@ -405,8 +413,29 @@ class Config:
     transfer_ahead: int = 2
 
     def __post_init__(self) -> None:
-        if self.model not in ("lr", "fm", "mvm", "ffm", "wide_deep"):
-            raise ValueError(f"unknown model {self.model!r}")
+        # registry-validated (models/__init__.py): new families become
+        # config-valid by registering, not by editing this file.  Late
+        # import — model modules import jax; config must stay
+        # importable before backend selection.
+        from xflow_tpu.models import model_names
+
+        if self.model not in model_names():
+            raise ValueError(
+                f"unknown model {self.model!r} (registered families: "
+                f"{', '.join(model_names())})"
+            )
+        if self.model == "two_tower" and not (
+            0 < self.tower_split_field < self.max_fields
+        ):
+            raise ValueError(
+                f"tower_split_field {self.tower_split_field} must be in "
+                f"(0, max_fields={self.max_fields}): both towers need "
+                "at least one field"
+            )
+        if self.tower_dim < 1:
+            raise ValueError("tower_dim must be >= 1")
+        if self.cross_layers < 1:
+            raise ValueError("cross_layers must be >= 1")
         if self.optimizer not in ("ftrl", "sgd"):
             raise ValueError(f"unknown optimizer {self.optimizer!r}")
         if self.update_mode not in ("dense", "sparse", "sequential"):

@@ -1,48 +1,166 @@
+"""Model registry — the ONE place a family plugs into the system.
+
+The reference dispatches models by positional index (main.cc:27-45,
+argv[3] '0'→LR '1'→FM '2'→MVM); this repo's five-then-seven families
+used to be re-enumerated as string literals in config validation, the
+CLI choices, the C-ABI docs, and the bench scripts — adding a family
+meant a scavenger hunt.  Now a family registers HERE once:
+
+* ``build`` — Config -> Model instance (the only constructor callers
+  use; serve/engine.py, trainer.py, the C ABI all route through
+  ``make_model``);
+* ``retrieval`` — the family factors into user/item towers
+  (``user_embed``/``item_embed``) whose item side exports a serve-time
+  top-k index (serve/artifact.py::export_item_index,
+  PredictEngine.topk).  Non-retrieval families refuse the index/top-k
+  surface with an actionable error instead of scoring garbage.
+
+``Config.__post_init__`` validates ``cfg.model`` against
+``model_names()``, ``xflow_tpu.train`` builds its ``--model`` choices
+from it, and ``scripts/bench_models.py`` enumerates it (a registered
+family without a bench geometry fails that script loudly) — so a new
+family is config-valid, CLI-reachable, C-ABI-servable, and
+bench-tracked by virtue of this one entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
 from xflow_tpu.models.base import AutodiffModel, Model, TableSpec
-from xflow_tpu.models.lr import LRModel
-from xflow_tpu.models.fm import FMModel
-from xflow_tpu.models.mvm import MVMModel
+from xflow_tpu.models.dcn import DCNModel
 from xflow_tpu.models.ffm import FFMModel
+from xflow_tpu.models.fm import FMModel
+from xflow_tpu.models.lr import LRModel
+from xflow_tpu.models.mvm import MVMModel
+from xflow_tpu.models.two_tower import TwoTowerModel
 from xflow_tpu.models.wide_deep import WideDeepModel
 
 
+@dataclasses.dataclass(frozen=True)
+class ModelFamily:
+    name: str
+    build: Callable[..., Model]  # (cfg: Config) -> Model
+    description: str
+    #: user/item-tower factorization: item tower exports a serve-time
+    #: top-k index (serve/artifact.py, PredictEngine.topk)
+    retrieval: bool = False
+
+
+REGISTRY: dict[str, ModelFamily] = {}
+
+
+def register_model(family: ModelFamily) -> ModelFamily:
+    """Add a family (refuses duplicate names — two registrations for
+    one name is always a bug, not an override)."""
+    if family.name in REGISTRY:
+        raise ValueError(f"model family {family.name!r} already registered")
+    REGISTRY[family.name] = family
+    return family
+
+
+def model_names() -> tuple[str, ...]:
+    return tuple(REGISTRY)
+
+
+def model_family(name: str) -> ModelFamily:
+    fam = REGISTRY.get(name)
+    if fam is None:
+        raise ValueError(
+            f"unknown model {name!r} (registered families: "
+            f"{', '.join(REGISTRY)})"
+        )
+    return fam
+
+
 def make_model(cfg) -> Model:
-    # Reference model dispatch: main.cc:27-45, argv[3] '0'→LR '1'→FM '2'→MVM;
-    # ffm/wide_deep are extensions (BASELINE.json target configs).
-    if cfg.model == "lr":
-        return LRModel()
-    if cfg.model == "fm":
-        return FMModel(v_dim=cfg.v_dim, v_init_scale=cfg.v_init_scale)
-    if cfg.model == "mvm":
-        return MVMModel(
-            v_dim=cfg.v_dim,
-            v_init_scale=cfg.v_init_scale,
-            max_fields=cfg.max_fields,
-        )
-    if cfg.model == "ffm":
-        return FFMModel(
-            v_dim=cfg.ffm_v_dim,
-            max_fields=cfg.max_fields,
-            v_init_scale=cfg.v_init_scale,
-        )
-    if cfg.model == "wide_deep":
-        return WideDeepModel(
-            emb_dim=cfg.emb_dim,
-            hidden=cfg.hidden_dim,
-            max_fields=cfg.max_fields,
-            v_init_scale=cfg.v_init_scale,
-        )
-    raise ValueError(f"unknown model {cfg.model!r}")
+    # Reference model dispatch: main.cc:27-45, argv[3] '0'→LR '1'→FM
+    # '2'→MVM; everything else is a capability extension registered
+    # above the reference's zoo.
+    return model_family(cfg.model).build(cfg)
+
+
+register_model(ModelFamily(
+    "lr", lambda cfg: LRModel(),
+    "sparse logistic regression (reference model 0)",
+))
+register_model(ModelFamily(
+    "fm",
+    lambda cfg: FMModel(v_dim=cfg.v_dim, v_init_scale=cfg.v_init_scale),
+    "2-way factorization machine (reference model 1)",
+))
+register_model(ModelFamily(
+    "mvm",
+    lambda cfg: MVMModel(
+        v_dim=cfg.v_dim,
+        v_init_scale=cfg.v_init_scale,
+        max_fields=cfg.max_fields,
+    ),
+    "multi-view machine (reference model 2)",
+))
+register_model(ModelFamily(
+    "ffm",
+    lambda cfg: FFMModel(
+        v_dim=cfg.ffm_v_dim,
+        max_fields=cfg.max_fields,
+        v_init_scale=cfg.v_init_scale,
+    ),
+    "field-aware FM (extension; BASELINE.json target)",
+))
+register_model(ModelFamily(
+    "wide_deep",
+    lambda cfg: WideDeepModel(
+        emb_dim=cfg.emb_dim,
+        hidden=cfg.hidden_dim,
+        max_fields=cfg.max_fields,
+        v_init_scale=cfg.v_init_scale,
+    ),
+    "wide & deep: sparse linear + embedding MLP (extension)",
+))
+register_model(ModelFamily(
+    "two_tower",
+    lambda cfg: TwoTowerModel(
+        emb_dim=cfg.emb_dim,
+        tower_dim=cfg.tower_dim,
+        hidden=cfg.hidden_dim,
+        max_fields=cfg.max_fields,
+        split_field=cfg.tower_split_field,
+        v_init_scale=cfg.v_init_scale,
+    ),
+    "two-tower retrieval: dot-product user/item towers over disjoint "
+    "field groups; item tower exports the serve-time top-k index",
+    retrieval=True,
+))
+register_model(ModelFamily(
+    "dcn",
+    lambda cfg: DCNModel(
+        emb_dim=cfg.emb_dim,
+        hidden=cfg.hidden_dim,
+        cross_layers=cfg.cross_layers,
+        max_fields=cfg.max_fields,
+        v_init_scale=cfg.v_init_scale,
+    ),
+    "deep & cross ranker: explicit bounded-degree feature crosses + "
+    "MLP over the embedding tower (the cascade's ranking stage)",
+))
 
 
 __all__ = [
     "AutodiffModel",
     "Model",
+    "ModelFamily",
+    "REGISTRY",
     "TableSpec",
     "LRModel",
     "FMModel",
     "MVMModel",
     "FFMModel",
     "WideDeepModel",
+    "TwoTowerModel",
+    "DCNModel",
     "make_model",
+    "model_family",
+    "model_names",
+    "register_model",
 ]

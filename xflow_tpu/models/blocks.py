@@ -1,0 +1,246 @@
+"""Composable model blocks — embedding towers + interaction blocks.
+
+Five CTR families grew up in ``models/`` each re-implementing the same
+sparse recipe: mask the values, gather rows, pool per field, interact,
+reduce.  This module is the single home of those pieces, used three
+ways:
+
+* the five incumbent families (lr/fm/mvm/ffm/wide_deep) express their
+  logits THROUGH these blocks — with **bitwise-unchanged** outputs
+  (tests/test_models.py pins every family's logit against a frozen
+  copy of the pre-refactor implementation, in dense, MXU-hot, and
+  tiered store modes);
+* the retrieval/ranking families this substrate enables
+  (models/two_tower.py, models/dcn.py) compose the same blocks into
+  new architectures instead of re-implementing the recipe a sixth and
+  seventh time;
+* future families register in models/__init__.py and pick blocks off
+  this shelf.
+
+Bitwise discipline: each block body is the EXACT expression lifted
+from the incumbent model it came from (same ops, same order, same
+einsum strings).  A change here is a numerics change for every family
+at once — the no-regression tests exist to catch exactly that.
+
+Blocks take gathered rows / already-masked values, never a model
+instance: they are jit-safe pure functions over arrays, so any model's
+``logit`` (and explicit ``grad_logit`` where the reference demands
+quirk parity) can call them inside the fused step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.models.base import BatchArrays
+
+# -- feature plumbing ---------------------------------------------------------
+
+
+def masked_x(batch: BatchArrays) -> jax.Array:
+    """Effective feature values: ``vals * mask`` [B, K] — zero for
+    padding, the value (1.0 in hash mode) for real entries.  Every
+    family's first line."""
+    return batch["vals"] * batch["mask"]
+
+
+def linear_term(w_rows: jax.Array, x: jax.Array) -> jax.Array:
+    """Sparse linear reduction ``sum_i w_i x_i`` [B] over gathered
+    [B, K, 1] w rows (lr_worker.cc:121-143's join as a masked gather
+    reduction) — LR's whole forward, FM/FFM/wide&deep/DCN's wide
+    half."""
+    return jnp.sum(w_rows[..., 0] * x, axis=-1)
+
+
+def valid_fields(
+    slots: jax.Array, mask: jax.Array, num_fields: int
+) -> jax.Array:
+    """Bool [B, K]: the entry is real AND its field id is in
+    [0, num_fields) — the shared out-of-range-field drop semantics
+    (negative or oversized fgids contribute nothing; mvm.py / ffm.py /
+    wide&deep's one-hot rows of zeros)."""
+    return (slots >= 0) & (slots < num_fields) & (mask > 0)
+
+
+# -- embedding tower ----------------------------------------------------------
+
+
+def field_sum_tower(
+    emb_rows: jax.Array,
+    x: jax.Array,
+    slots: jax.Array,
+    num_fields: int,
+) -> jax.Array:
+    """THE embedding tower: value-scaled embeddings field-sum-pooled
+    into ``num_fields`` buckets — [B, F, E] from gathered [B, K, E]
+    rows.  One one-hot + one MXU batch-matmul, so variable
+    features-per-field work under static shapes; out-of-range fields
+    get an all-zero one-hot row and drop out.  Lifted verbatim from
+    wide&deep's deep half; two_tower and dcn build their towers on
+    it."""
+    onehot = jax.nn.one_hot(
+        slots, num_fields, dtype=x.dtype
+    )  # [B, K, F]; out-of-range fields drop out
+    embx = emb_rows * x[..., None]  # [B, K, E]
+    return jnp.einsum("bkf,bke->bfe", onehot, embx)  # [B, F, E]
+
+
+def flatten_tower(field_emb: jax.Array) -> jax.Array:
+    """[B, F, E] -> [B, F*E]: the tower's dense-layer interface."""
+    return field_emb.reshape(field_emb.shape[0], -1)
+
+
+# -- MLP blocks (replicated dense params; plain-SGD updated — see
+# parallel/step.py::apply_dense_sgd) -----------------------------------------
+
+
+def mlp_head_init(
+    rng: jax.Array, in_dim: int, hidden: int
+) -> dict[str, jax.Array]:
+    """He-init 2-layer scalar head (wide&deep's exact dense geometry):
+    in_dim -> hidden (ReLU) -> 1."""
+    k1, k2 = jax.random.split(rng)
+    return {
+        "w1": jax.random.normal(k1, (in_dim, hidden), jnp.float32)
+        * jnp.sqrt(2.0 / in_dim),
+        "b1": jnp.zeros((hidden,), jnp.float32),
+        "w2": jax.random.normal(k2, (hidden, 1), jnp.float32)
+        * jnp.sqrt(1.0 / hidden),
+        "b2": jnp.zeros((1,), jnp.float32),
+    }
+
+
+def mlp_head(dense: dict, h: jax.Array) -> jax.Array:
+    """2-layer ReLU scalar head -> [B] (wide&deep's deep output,
+    verbatim)."""
+    h = jax.nn.relu(h @ dense["w1"] + dense["b1"])
+    return (h @ dense["w2"] + dense["b2"])[:, 0]
+
+
+def mlp_tower_init(
+    rng: jax.Array, in_dim: int, hidden: int, out_dim: int,
+    prefix: str = "",
+) -> dict[str, jax.Array]:
+    """He-init 2-layer VECTOR tower: in_dim -> hidden (ReLU) ->
+    out_dim, keys prefixed so two towers coexist in one dense pytree
+    (two_tower's u_/i_ pair)."""
+    k1, k2 = jax.random.split(rng)
+    return {
+        f"{prefix}w1": jax.random.normal(
+            k1, (in_dim, hidden), jnp.float32
+        ) * jnp.sqrt(2.0 / in_dim),
+        f"{prefix}b1": jnp.zeros((hidden,), jnp.float32),
+        f"{prefix}w2": jax.random.normal(
+            k2, (hidden, out_dim), jnp.float32
+        ) * jnp.sqrt(1.0 / hidden),
+        f"{prefix}b2": jnp.zeros((out_dim,), jnp.float32),
+    }
+
+
+def mlp_tower(dense: dict, h: jax.Array, prefix: str = "") -> jax.Array:
+    """2-layer ReLU vector tower -> [B, out_dim]."""
+    h = jax.nn.relu(h @ dense[f"{prefix}w1"] + dense[f"{prefix}b1"])
+    return h @ dense[f"{prefix}w2"] + dense[f"{prefix}b2"]
+
+
+def dot_interaction(u: jax.Array, v: jax.Array) -> jax.Array:
+    """Row-wise dot product [B] of two [B, D] tower outputs — the
+    two-tower training logit AND the serve-time top-k score (the
+    index scan is the same dot against every item row)."""
+    return jnp.sum(u * v, axis=-1)
+
+
+def cross_network(
+    x0: jax.Array, cross_w: jax.Array, cross_b: jax.Array
+) -> jax.Array:
+    """DCN explicit cross stack: ``x_{l+1} = x0 * (x_l . w_l) + b_l +
+    x_l`` over ``cross_w [L, P]`` / ``cross_b [L, P]`` — each layer
+    adds one learned degree of bounded polynomial feature interaction
+    at O(P) parameters (vs the MLP's O(P*H))."""
+    x = x0
+    for layer in range(cross_w.shape[0]):
+        xw = jnp.sum(x * cross_w[layer], axis=-1, keepdims=True)  # [B, 1]
+        x = x0 * xw + cross_b[layer] + x
+    return x
+
+
+# -- factorization interactions ----------------------------------------------
+
+
+def fm_pair_pieces(
+    v_rows: jax.Array, x: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """FM second-order pieces over gathered [B, K, D] v rows:
+    ``(sum_i v_i x_i, sum_i (v_i x_i)^2)`` both [B, D]
+    (fm_worker.cc:63-86's square-of-sum/sum-of-squares identity).
+    The forward combines them WITHOUT the standard ½ factor (reference
+    quirk, models/fm.py docstring); the backward reads sum_vx
+    directly."""
+    vx = v_rows * x[..., None]  # [B, K, D]
+    sum_vx = jnp.sum(vx, axis=1)  # [B, D]
+    sum_vx2 = jnp.sum(vx * vx, axis=1)  # [B, D]
+    return sum_vx, sum_vx2
+
+
+def mvm_slot_terms(
+    v_rows: jax.Array,
+    x: jax.Array,
+    slots: jax.Array,
+    num_fields: int,
+) -> tuple[jax.Array, jax.Array]:
+    """MVM per-factor view products: ``(1 + slotsum [B, S, D],
+    prod over S [B, D])`` in the fixed consistent 1+sum form
+    (models/mvm.py docstring; mvm_worker.cc:67-95)."""
+    onehot = jax.nn.one_hot(
+        slots, num_fields, dtype=x.dtype
+    )  # [B, K, S]; fgid >= num_fields rows are all-zero → feature ignored
+    vx = v_rows * x[..., None]  # [B, K, D]
+    slotsum = jnp.einsum("bks,bkd->bsd", onehot, vx)  # [B, S, D]
+    one_plus = 1.0 + slotsum
+    prod = jnp.prod(one_plus, axis=1)  # [B, D]
+    return one_plus, prod
+
+
+def ffm_field_interaction(
+    v_rows: jax.Array,
+    x_eff: jax.Array,
+    slot: jax.Array,
+    valid: jax.Array,
+    num_fields: int,
+    v_dim: int,
+) -> jax.Array:
+    """FFM pairwise term via the field-aggregated identity
+    (models/ffm.py docstring: O(B*K*F^2*D) MXU compute, O(B*F^2*D)
+    memory, no [B, K, K, D] pair tensors).  ``v_rows`` is the flat
+    [B, K, F*D] gathered v plane, ``x_eff`` the validity-zeroed
+    values, ``slot`` the [0, F)-clipped field ids.  Returns the [B]
+    interaction (½(cross − diag)); the TPU layout constraints
+    (E = F*D stays the minor dim throughout) ride along unchanged."""
+    b, k = slot.shape
+    f, d = num_fields, v_dim
+    # one-hot of each feature's own field; zero row for invalid
+    onehot = (
+        (slot[:, :, None] == jnp.arange(f)[None, None, :])
+        & valid[:, :, None]
+    ).astype(v_rows.dtype)  # [B, K, F]
+
+    # TPU layout constraint: every materialized tensor keeps the
+    # flattened E = F*D as its minor dimension (models/ffm.py round-4
+    # log: a D-minor operand gets T(8,128) lane padding — 32x memory)
+    vx = v_rows * x_eff[:, :, None]  # [B, K, E]
+    # field-aggregated sums: one batch matmul contracting K (MXU)
+    s = jnp.einsum("bkf,bke->bfe", onehot, vx)  # [B, F, E]
+
+    # cross term sum_{f1,f2,d} S[b,f1,f2,d] * S[b,f2,f1,d]: stays an
+    # elementwise fusion over s read twice — never a dot_general
+    s4 = s.reshape(b, f, f, d)
+    cross = jnp.sum(
+        s4 * jnp.transpose(s4, (0, 2, 1, 3)), axis=(1, 2, 3)
+    )
+    # subtract the i == i diagonal: x_i^2 * ||v[k_i, f_i, :]||^2,
+    # selecting each key's own-field block of E elementwise
+    eslot = (jnp.arange(f * d) // d).astype(slot.dtype)  # [E]
+    emask = eslot[None, None, :] == slot[:, :, None]  # [B, K, E]
+    diag = jnp.sum(jnp.where(emask, vx * vx, 0.0), axis=(1, 2))
+    return 0.5 * (cross - diag)

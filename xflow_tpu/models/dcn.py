@@ -1,0 +1,111 @@
+"""Deep & Cross ranker: explicit bounded-degree feature crosses + MLP.
+
+The cascade's RANKING stage (docs/SERVING.md): where the two-tower
+retriever is architecturally forbidden from crossing user and item
+features (the dot factorization is what makes the index precomputable),
+the ranker exists to model exactly those crosses over the few hundred
+retrieved candidates.  DCN (Deep & Cross Network) makes the crossing
+explicit and cheap:
+
+    x_0     = flattened field-pooled embedding tower  [B, P]
+    x_{l+1} = x_0 * (x_l . w_l) + b_l + x_l           (cross stack)
+    h       = ReLU(x_0 W1 + b1)                       (deep half)
+    logit   = wide + [x_L ; h] W_out + b_out
+
+Each cross layer adds one learned degree of polynomial interaction at
+O(P) parameters — the standard alternative to FM/FFM's fixed
+second-order forms when the interactions worth modeling are sparse
+and data-determined.
+
+Composed from models/blocks.py (field_sum_tower / cross_network /
+linear_term); the wide half and the dense-parameter path (replicated
+pytree, plain-SGD via parallel/step.py::apply_dense_sgd) are exactly
+wide&deep's — no new train-step machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.models.base import AutodiffModel, BatchArrays, TableSpec
+from xflow_tpu.models.blocks import (
+    cross_network,
+    field_sum_tower,
+    flatten_tower,
+    linear_term,
+    masked_x,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DCNModel(AutodiffModel):
+    emb_dim: int = 8
+    hidden: int = 64
+    cross_layers: int = 2
+    max_fields: int = 32
+    v_init_scale: float = 1e-2
+    name: str = "dcn"
+
+    def __post_init__(self) -> None:
+        if self.cross_layers < 1:
+            raise ValueError(
+                f"dcn cross_layers {self.cross_layers} must be >= 1 "
+                "(0 layers is wide&deep — use that family)"
+            )
+
+    def tables(self) -> list[TableSpec]:
+        return [
+            TableSpec("w", 1, lambda rng, shape: jnp.zeros(shape, jnp.float32)),
+            TableSpec(
+                "emb",
+                self.emb_dim,
+                lambda rng, shape: (
+                    jax.random.normal(rng, shape, jnp.float32)
+                    * self.v_init_scale
+                ),
+                init_kind="normal",
+                init_scale=self.v_init_scale,
+            ),
+        ]
+
+    def dense_init(self, rng: jax.Array) -> dict:
+        kc, k1, ko = jax.random.split(rng, 3)
+        p = self.max_fields * self.emb_dim
+        # cross weights start small (each layer perturbs the identity
+        # path x_l + ...); biases zero; He for the ReLU deep half.
+        return {
+            "cross_w": jax.random.normal(
+                kc, (self.cross_layers, p), jnp.float32
+            ) * jnp.sqrt(1.0 / p),
+            "cross_b": jnp.zeros((self.cross_layers, p), jnp.float32),
+            "w1": jax.random.normal(k1, (p, self.hidden), jnp.float32)
+            * jnp.sqrt(2.0 / p),
+            "b1": jnp.zeros((self.hidden,), jnp.float32),
+            "w_out": jax.random.normal(
+                ko, (p + self.hidden, 1), jnp.float32
+            ) * jnp.sqrt(1.0 / (p + self.hidden)),
+            "b_out": jnp.zeros((1,), jnp.float32),
+        }
+
+    def logit(
+        self,
+        rows: dict[str, jax.Array],
+        batch: BatchArrays,
+        dense: dict | None = None,
+    ) -> jax.Array:
+        assert dense is not None, "dcn requires dense cross/MLP params"
+        x = masked_x(batch)  # [B, K]
+        wide = linear_term(rows["w"], x)
+        x0 = flatten_tower(field_sum_tower(
+            rows["emb"], x, batch["slots"], self.max_fields
+        ))  # [B, P]
+        xc = cross_network(x0, dense["cross_w"], dense["cross_b"])
+        h = jax.nn.relu(x0 @ dense["w1"] + dense["b1"])
+        out = (
+            jnp.concatenate([xc, h], axis=-1) @ dense["w_out"]
+            + dense["b_out"]
+        )[:, 0]
+        return wide + out

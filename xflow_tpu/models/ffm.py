@@ -37,6 +37,12 @@ import jax
 import jax.numpy as jnp
 
 from xflow_tpu.models.base import AutodiffModel, BatchArrays, TableSpec
+from xflow_tpu.models.blocks import (
+    ffm_field_interaction,
+    linear_term,
+    masked_x,
+    valid_fields,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,48 +77,20 @@ class FFMModel(AutodiffModel):
         batch: BatchArrays,
         dense: dict | None = None,
     ) -> jax.Array:
-        b, k = batch["keys"].shape
-        f, d = self.max_fields, self.v_dim
-        x = batch["vals"] * batch["mask"]  # [B, K]
-        linear = jnp.sum(rows["w"][..., 0] * x, axis=-1)
+        f = self.max_fields
+        x = masked_x(batch)  # [B, K]
+        linear = linear_term(rows["w"], x)
 
-        valid = (
-            (batch["slots"] >= 0) & (batch["slots"] < f) & (batch["mask"] > 0)
-        )  # [B, K] — negative field ids dropped, matching MVM/Wide&Deep
+        # negative field ids dropped, matching MVM/Wide&Deep
+        valid = valid_fields(batch["slots"], batch["mask"], f)
         x_eff = jnp.where(valid, x, 0.0)
         slot = jnp.clip(batch["slots"], 0, f - 1)  # [B, K]
-        # one-hot of each feature's own field; zero row for invalid
-        onehot = (
-            (slot[:, :, None] == jnp.arange(f)[None, None, :])
-            & valid[:, :, None]
-        ).astype(rows["v"].dtype)  # [B, K, F]
-
-        # TPU layout constraint: every materialized tensor keeps the
-        # flattened E = F*D as its minor dimension.  A [.., D=4]-minor
-        # operand gets T(8,128) lane padding — 32x physical memory; the
-        # first shape of this model OOM'd a 16 GB chip at B=32768 with
-        # a 26 GB copy of the [B,K,F,D] pair operand (round-4 log).
-        vx = rows["v"] * x_eff[:, :, None]  # [B, K, E]
-        # field-aggregated sums: one batch matmul contracting K (MXU);
-        # operand minor dims are F (padded 39->128 one-hot) and E=156
-        # (->256) — no 32x blowup, no [B, K, K, *] pair tensors
-        s = jnp.einsum("bkf,bke->bfe", onehot, vx)  # [B, F, E]
-
-        # cross term sum_{f1,f2,d} S[b,f1,f2,d] * S[b,f2,f1,d]: the
-        # (f1<->f2, d fixed) transpose + multiply + reduce stays an
-        # elementwise fusion over s read twice — never a dot_general,
-        # whose operand copies would resurrect the D-minor layout
-        s4 = s.reshape(b, f, f, d)
-        cross = jnp.sum(
-            s4 * jnp.transpose(s4, (0, 2, 1, 3)), axis=(1, 2, 3)
+        # the field-aggregated pairwise identity + its TPU layout
+        # discipline live in blocks.ffm_field_interaction (E = F*D
+        # stays the minor dim; no [B, K, K, *] pair tensors)
+        return linear + ffm_field_interaction(
+            rows["v"], x_eff, slot, valid, f, self.v_dim
         )
-        # subtract the i == i diagonal: x_i^2 * ||v[k_i, f_i, :]||^2.
-        # Select each key's own-field block of E elementwise (e//D ==
-        # slot) instead of take_along_axis — same fusion argument.
-        eslot = (jnp.arange(f * d) // d).astype(slot.dtype)  # [E]
-        emask = eslot[None, None, :] == slot[:, :, None]  # [B, K, E]
-        diag = jnp.sum(jnp.where(emask, vx * vx, 0.0), axis=(1, 2))
-        return linear + 0.5 * (cross - diag)
 
     def logit_pairwise(
         self,

@@ -17,6 +17,10 @@ v rows are initialized N(0,1)*1e-2 (the reference does this lazily
 server-side on first touch, ftrl.h:113-120; see optim/ftrl.py for the
 equivalence argument), laid out [key, d in 0..v_dim) as in
 fm_worker.cc:71.
+
+Expressed through models/blocks.py (masked_x / linear_term /
+fm_pair_pieces) — bitwise-unchanged vs the pre-refactor forms
+(tests/test_models.py no-regression pins).
 """
 
 from __future__ import annotations
@@ -27,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from xflow_tpu.models.base import BatchArrays, TableSpec
+from xflow_tpu.models.blocks import fm_pair_pieces, linear_term, masked_x
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,27 +57,18 @@ class FMModel:
             ),
         ]
 
-    def _interaction_pieces(
-        self, rows: dict[str, jax.Array], batch: BatchArrays
-    ) -> tuple[jax.Array, jax.Array]:
-        x = (batch["vals"] * batch["mask"])[..., None]  # [B, K, 1]
-        vx = rows["v"] * x  # [B, K, D]
-        sum_vx = jnp.sum(vx, axis=1)  # [B, D]
-        sum_vx2 = jnp.sum(vx * vx, axis=1)  # [B, D]
-        return sum_vx, sum_vx2
-
     def logit(self, rows: dict[str, jax.Array], batch: BatchArrays) -> jax.Array:
-        x = batch["vals"] * batch["mask"]
-        linear = jnp.sum(rows["w"][..., 0] * x, axis=-1)
-        sum_vx, sum_vx2 = self._interaction_pieces(rows, batch)
+        x = masked_x(batch)
+        linear = linear_term(rows["w"], x)
+        sum_vx, sum_vx2 = fm_pair_pieces(rows["v"], x)
         # No ½ factor: fm_worker.cc:82,86.
         return linear + jnp.sum(sum_vx * sum_vx - sum_vx2, axis=-1)
 
     def grad_logit(
         self, rows: dict[str, jax.Array], batch: BatchArrays
     ) -> dict[str, jax.Array]:
-        x = batch["vals"] * batch["mask"]  # [B, K]
-        sum_vx, _ = self._interaction_pieces(rows, batch)
+        x = masked_x(batch)  # [B, K]
+        sum_vx, _ = fm_pair_pieces(rows["v"], x)
         vx = rows["v"] * x[..., None]
         # (sum_vx - v_id x_i) * x_i — fm_worker.cc:140-142 (½-scaled form).
         grad_v = (sum_vx[:, None, :] - vx) * x[..., None]

@@ -10,6 +10,10 @@ bare w; we multiply by the feature value, which is 1.0 in hash mode
 Gradient: d wx / d w_i = x_i (= 1 for binary); the train step scales by
 (sigma(wx) - y) / batch_n, matching calculate_gradient's mean-over-batch
 (lr_worker.cc:100-119).
+
+Expressed through models/blocks.py (masked_x + linear_term) — the
+blocks ARE the pre-refactor expressions, bitwise
+(tests/test_models.py no-regression pins).
 """
 
 from __future__ import annotations
@@ -18,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from xflow_tpu.models.base import BatchArrays, TableSpec
+from xflow_tpu.models.blocks import linear_term, masked_x
 
 
 class LRModel:
@@ -32,11 +37,10 @@ class LRModel:
         return [TableSpec("w", 1, lambda rng, shape: jnp.zeros(shape, jnp.float32))]
 
     def logit(self, rows: dict[str, jax.Array], batch: BatchArrays) -> jax.Array:
-        x = batch["vals"] * batch["mask"]  # [B, K]
-        return jnp.sum(rows["w"][..., 0] * x, axis=-1)
+        return linear_term(rows["w"], masked_x(batch))
 
     def grad_logit(
         self, rows: dict[str, jax.Array], batch: BatchArrays
     ) -> dict[str, jax.Array]:
-        x = batch["vals"] * batch["mask"]
+        x = masked_x(batch)
         return {"w": x[..., None]}

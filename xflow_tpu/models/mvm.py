@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from xflow_tpu.models.base import BatchArrays, TableSpec
+from xflow_tpu.models.blocks import masked_x, mvm_slot_terms
 
 _GUARD_EPS = 1e-12
 
@@ -72,16 +73,11 @@ class MVMModel:
     def _slot_terms(
         self, rows: dict[str, jax.Array], batch: BatchArrays
     ) -> tuple[jax.Array, jax.Array]:
-        """Returns (one_plus_slotsum [B, S, D], prod over S [B, D])."""
-        x = batch["vals"] * batch["mask"]  # [B, K]
-        onehot = jax.nn.one_hot(
-            batch["slots"], self.max_fields, dtype=x.dtype
-        )  # [B, K, S]; fgid >= max_fields rows are all-zero → feature ignored
-        vx = rows["v"] * x[..., None]  # [B, K, D]
-        slotsum = jnp.einsum("bks,bkd->bsd", onehot, vx)  # [B, S, D]
-        one_plus = 1.0 + slotsum
-        prod = jnp.prod(one_plus, axis=1)  # [B, D]
-        return one_plus, prod
+        """Returns (one_plus_slotsum [B, S, D], prod over S [B, D]) —
+        blocks.mvm_slot_terms, bitwise the pre-refactor expression."""
+        return mvm_slot_terms(
+            rows["v"], masked_x(batch), batch["slots"], self.max_fields
+        )
 
     def logit(self, rows: dict[str, jax.Array], batch: BatchArrays) -> jax.Array:
         _, prod = self._slot_terms(rows, batch)
@@ -91,7 +87,7 @@ class MVMModel:
     def grad_logit(
         self, rows: dict[str, jax.Array], batch: BatchArrays
     ) -> dict[str, jax.Array]:
-        x = batch["vals"] * batch["mask"]  # [B, K]
+        x = masked_x(batch)  # [B, K]
         one_plus, prod = self._slot_terms(rows, batch)
         slot_idx = jnp.clip(batch["slots"], 0, self.max_fields - 1)  # [B, K]
         own = jnp.take_along_axis(

@@ -25,6 +25,14 @@ import jax
 import jax.numpy as jnp
 
 from xflow_tpu.models.base import AutodiffModel, BatchArrays, TableSpec
+from xflow_tpu.models.blocks import (
+    field_sum_tower,
+    flatten_tower,
+    linear_term,
+    masked_x,
+    mlp_head,
+    mlp_head_init,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,17 +58,11 @@ class WideDeepModel(AutodiffModel):
         ]
 
     def dense_init(self, rng: jax.Array) -> dict:
-        k1, k2 = jax.random.split(rng)
-        in_dim = self.max_fields * self.emb_dim
-        # He init for the ReLU layer, small linear head.
-        return {
-            "w1": jax.random.normal(k1, (in_dim, self.hidden), jnp.float32)
-            * jnp.sqrt(2.0 / in_dim),
-            "b1": jnp.zeros((self.hidden,), jnp.float32),
-            "w2": jax.random.normal(k2, (self.hidden, 1), jnp.float32)
-            * jnp.sqrt(1.0 / self.hidden),
-            "b2": jnp.zeros((1,), jnp.float32),
-        }
+        # He init for the ReLU layer, small linear head
+        # (blocks.mlp_head_init — the lifted pre-refactor geometry).
+        return mlp_head_init(
+            rng, self.max_fields * self.emb_dim, self.hidden
+        )
 
     def logit(
         self,
@@ -69,15 +71,12 @@ class WideDeepModel(AutodiffModel):
         dense: dict | None = None,
     ) -> jax.Array:
         assert dense is not None, "wide_deep requires dense MLP params"
-        x = batch["vals"] * batch["mask"]  # [B, K]
-        wide = jnp.sum(rows["w"][..., 0] * x, axis=-1)
-
-        onehot = jax.nn.one_hot(
-            batch["slots"], self.max_fields, dtype=x.dtype
-        )  # [B, K, F]; out-of-range fields drop out
-        embx = rows["emb"] * x[..., None]  # [B, K, E]
-        field_emb = jnp.einsum("bkf,bke->bfe", onehot, embx)  # [B, F, E]
-        h = field_emb.reshape(field_emb.shape[0], -1)  # [B, F*E]
-        h = jax.nn.relu(h @ dense["w1"] + dense["b1"])
-        deep = (h @ dense["w2"] + dense["b2"])[:, 0]
+        x = masked_x(batch)  # [B, K]
+        wide = linear_term(rows["w"], x)
+        # embedding tower + scalar MLP head, both straight off the
+        # blocks shelf (field_sum_tower IS the lifted deep half)
+        field_emb = field_sum_tower(
+            rows["emb"], x, batch["slots"], self.max_fields
+        )  # [B, F, E]
+        deep = mlp_head(dense, flatten_tower(field_emb))
         return wide + deep

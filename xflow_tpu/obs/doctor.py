@@ -23,6 +23,10 @@ diagnosis instead of raw JSONL:
   admission control rejected most offered traffic — blamed on
   capacity, explicitly NOT on the queue) and canary-stuck rollouts
   (a ``rollout`` stream that ends on ``begin``/``canary``);
+* retrieval→ranking cascade → candidate starvation (``cascade`` rows
+  where the retrieval stage answered with fewer than the requested k)
+  and per-stage p99 attribution (a slow cascade blames the right
+  fleet by name);
 * continuous training → servable-stale streams (``freshness`` rows,
   docs/CONTINUOUS.md): last newest-event-age over its SLO, rollouts
   repeatedly aborting, or begins that never commit;
@@ -437,6 +441,72 @@ def _check_serve(
     return out
 
 
+def _check_cascade(rows: list[dict]) -> list[Diagnosis]:
+    """Retrieval→ranking cascade health from the ``cascade`` stats
+    windows (serve/cascade.py; docs/SERVING.md):
+
+    * **candidate_starvation** — the retrieval stage answered requests
+      with fewer candidates than the requested k (an index smaller
+      than k, or a retrieval rollout that shrank it): the ranker is
+      scoring a thinner slate than the caller asked for.
+    * **cascade_errors** — requests failed inside a stage (warn; the
+      per-fleet serve rows name the replica).
+    * **cascade_stage_p99** — per-stage p99 attribution (info): which
+      stage dominates the e2e tail, so a slow cascade blames the
+      right fleet instead of "serving is slow"."""
+    crows = [
+        r for r in rows
+        if r.get("kind") == "cascade" and int(r.get("requests", 0)) > 0
+    ]
+    if not crows:
+        return []
+    out: list[Diagnosis] = []
+    starved = sum(int(r.get("starved", 0)) for r in crows)
+    if starved:
+        r = next(r for r in crows if int(r.get("starved", 0)))
+        out.append(Diagnosis(
+            "warn",
+            "candidate_starvation",
+            f"candidate starvation: {starved} request(s) got fewer "
+            f"candidates than requested (k={r.get('k')}, mean "
+            f"returned {r.get('k_returned_mean')}) — the retrieval "
+            "index holds fewer items than k (or a rollout shrank "
+            "it); re-export the item index or lower the cascade k "
+            "(docs/SERVING.md)",
+        ))
+    errors = sum(int(r.get("errors", 0)) for r in crows)
+    if errors:
+        out.append(Diagnosis(
+            "warn",
+            "cascade_errors",
+            f"{errors} cascade request(s) failed inside a stage — "
+            "check the per-fleet serve_shed/health rows to see which "
+            "stage's replicas raised",
+        ))
+    last = crows[-1]
+    rp99 = float(last.get("retrieval_p99", 0.0))
+    kp99 = float(last.get("rank_p99", 0.0))
+    e2e = float(last.get("e2e_p99", 0.0))
+    if e2e > 0:
+        stage, worst = (
+            ("retrieval", rp99) if rp99 >= kp99 else ("ranking", kp99)
+        )
+        # per-stage and e2e percentiles come from different request
+        # populations (a stage-2 shed books retrieval but not e2e), so
+        # the share is capped at 100% rather than reported as an
+        # impossible 200%
+        share = min(100.0, 100 * worst / e2e)
+        out.append(Diagnosis(
+            "info",
+            "cascade_stage_p99",
+            f"cascade p99 attribution: e2e {1e3 * e2e:.1f}ms ≈ "
+            f"retrieval {1e3 * rp99:.1f}ms + ranking "
+            f"{1e3 * kp99:.1f}ms — the {stage} stage dominates "
+            f"({share:.0f}%); scale THAT fleet first",
+        ))
+    return out
+
+
 def _check_freshness(rows: list[dict]) -> list[Diagnosis]:
     """Continuous-training freshness (stream/driver.py ``freshness``
     rows; docs/CONTINUOUS.md).  A stream run must not read as clean
@@ -706,6 +776,7 @@ def diagnose(
             d.code == "serve_queue_stall" for d in findings
         ),
     ))
+    findings.extend(_check_cascade(rows))
     findings.extend(_check_freshness(rows))
     if flight is not None:
         findings.extend(_check_flight(flight))

@@ -205,6 +205,28 @@ SCHEMA: dict[str, dict[str, Any]] = {
         "canary_errors": int,
         "detail": str,
     },
+    # one per retrieval→ranking cascade stats window
+    # (serve/cascade.py; docs/SERVING.md "Retrieval→ranking cascade"):
+    # per-stage latency attribution (retrieval vs ranking p50/p99 —
+    # `obs doctor` blames the right fleet) and candidate accounting
+    # (k requested vs returned; `starved` counts requests the
+    # retrieval stage answered with fewer than k candidates)
+    "cascade": {
+        "t": (int, float),
+        "kind": str,
+        "requests": int,
+        "errors": int,
+        "shed_total": int,
+        "starved": int,
+        "k": int,
+        "k_returned_mean": (int, float),
+        "retrieval_p50": (int, float),
+        "retrieval_p99": (int, float),
+        "rank_p50": (int, float),
+        "rank_p99": (int, float),
+        "e2e_p50": (int, float),
+        "e2e_p99": (int, float),
+    },
     # one per continuous-training export/rollout transition
     # (stream/driver.py; docs/CONTINUOUS.md): event is export (a
     # delta/base was cut) / commit (the canary gate passed and the

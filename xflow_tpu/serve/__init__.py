@@ -37,11 +37,30 @@ The production tier stacks three more layers on those (docs/SERVING.md
   accounting (``serve_bench`` rows gated by
   scripts/check_serve_slo.py).
 
-CLI: ``python -m xflow_tpu.serve serve|loadgen|bench|score``
+And the candidate-generation half of a real recommender stack rides
+the same fleets (docs/SERVING.md "Retrieval→ranking cascade"):
+
+* ``artifact.export_item_index`` — freezes a two-tower model's item
+  embeddings (+ the candidates' feature planes) into a serve-time
+  index beside the artifact; ``PredictEngine.topk`` scores it by dot
+  product, AOT-compiled per bucket like predict;
+* ``cascade`` — ``CascadeEngine``: routes a request through a
+  retrieval fleet's top-k endpoint and feeds the candidates to a
+  ranking fleet's score endpoint, with front-door admission control,
+  per-stage latency/candidate-count ``cascade`` JSONL rows, and
+  independent staged rollout of either stage.
+
+CLI: ``python -m xflow_tpu.serve serve|cascade|loadgen|bench|score``
 (docs/SERVING.md).
 """
 
-from xflow_tpu.serve.artifact import export_artifact, load_manifest
+from xflow_tpu.serve.artifact import (
+    export_artifact,
+    export_item_index,
+    load_item_index,
+    load_manifest,
+)
+from xflow_tpu.serve.cascade import CascadeEngine
 from xflow_tpu.serve.batcher import MicroBatcher
 from xflow_tpu.serve.engine import DEFAULT_BUCKETS, PredictEngine
 from xflow_tpu.serve.fleet import AdmissionPolicy, ReplicaFleet, ShedError
@@ -50,7 +69,10 @@ from xflow_tpu.serve.server import ServeTier
 
 __all__ = [
     "export_artifact",
+    "export_item_index",
+    "load_item_index",
     "load_manifest",
+    "CascadeEngine",
     "PredictEngine",
     "MicroBatcher",
     "DEFAULT_BUCKETS",

@@ -1,4 +1,26 @@
-"""CLI: ``python -m xflow_tpu.serve <serve|loadgen|bench|score> ...``
+"""CLI: ``python -m xflow_tpu.serve <serve|index|cascade|loadgen|bench|score>``
+
+    index   ARTIFACT --input FILE     build the serve-time item index
+                                      beside a retrieval artifact from
+                                      libffm item rows (item-side
+                                      features deduplicated into the
+                                      catalog, embedded through the
+                                      item tower — serve/artifact.py::
+                                      export_item_index)
+
+    cascade RETRIEVAL RANKING --port P
+                                      retrieval→ranking cascade tier
+                                      (serve/cascade.py): a top-k fleet
+                                      over the retrieval artifact's
+                                      item index feeding a point-score
+                                      fleet over the ranking artifact,
+                                      behind one HTTP front end
+                                      (/v1/recommend, /v1/topk,
+                                      /v1/score; rollout endpoints
+                                      take "stage": "retrieval"|
+                                      "ranking"); emits `cascade`
+                                      JSONL stats windows
+
 
     score   ARTIFACT --input FILE     pctr per libffm line (stdout/--out)
     bench   ARTIFACT [--requests N]   closed-loop concurrent load through
@@ -272,6 +294,131 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_index(args) -> int:
+    """Build the serve-time item index beside a retrieval artifact
+    from libffm-format lines: each line's ITEM-side features (fields
+    >= the artifact's tower_split_field) become one catalog item,
+    deduplicated by feature set, embedded through the item tower, and
+    frozen via serve.artifact.export_item_index."""
+    from xflow_tpu.io.loader import make_parse_fn
+    from xflow_tpu.serve.artifact import (
+        export_item_index,
+        item_catalog_from_block,
+    )
+    from xflow_tpu.serve.engine import PredictEngine
+
+    engine = PredictEngine.load(
+        args.artifact,
+        num_devices=args.num_devices,
+        buckets=_buckets(args.buckets),
+        warm=False,
+    )
+    cfg = engine.cfg
+    parse = make_parse_fn(
+        cfg.table_size, cfg.hash_mode, cfg.seed,
+        prefer_native=cfg.native_parser,
+    )
+    src = open(args.input, "rb") if args.input else sys.stdin.buffer
+    try:
+        block = parse(src.read())
+    finally:
+        if args.input:
+            src.close()
+    items = item_catalog_from_block(
+        block, cfg.tower_split_field, args.max_items
+    )
+    if not items:
+        print(
+            "error: no item-side features found (fields >= "
+            f"tower_split_field={cfg.tower_split_field})",
+            file=sys.stderr,
+        )
+        return 1
+    meta = export_item_index(engine, args.artifact, items)
+    print(json.dumps({
+        "artifact": args.artifact,
+        "items": meta["count"],
+        "dim": meta["dim"],
+        "servable": meta["servable"],
+    }, sort_keys=True))
+    return 0
+
+
+def cmd_cascade(args) -> int:
+    """The cascade tier: retrieval top-k fleet + ranking fleet +
+    CascadeEngine behind one HTTP front end, alive until
+    SIGTERM/SIGINT, then a graceful drain (retrieval first, then
+    ranking — in-flight fan-outs land before the ranking queues
+    close)."""
+    import signal
+
+    from xflow_tpu.serve.cascade import CascadeEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.server import ServeTier
+
+    retrieval = ReplicaFleet.load(
+        args.retrieval,
+        replicas=args.replicas,
+        num_devices=args.num_devices,
+        buckets=_buckets(args.buckets),
+        max_wait_ms=args.max_wait_ms,
+        deadline_budget_ms=args.deadline_budget_ms,
+        depth_budget=args.depth_budget,
+        topk_k=args.topk_k,
+        topk=True,
+    )
+    ranking = ReplicaFleet.load(
+        args.ranking,
+        replicas=args.replicas,
+        num_devices=args.num_devices,
+        buckets=_buckets(args.buckets),
+        max_wait_ms=args.max_wait_ms,
+        deadline_budget_ms=args.deadline_budget_ms,
+        depth_budget=args.depth_budget,
+    )
+    logger = _serve_logger(
+        args.metrics_out, ranking.digest, ranking.cfg.model, "cascade"
+    )
+    retrieval.metrics_logger = logger
+    ranking.metrics_logger = logger
+    cascade = CascadeEngine(
+        retrieval, ranking, k=args.k, metrics_logger=logger
+    )
+    tier = ServeTier(
+        ranking,
+        host=args.host,
+        port=args.port,
+        default_canary_frac=args.canary_frac,
+        cascade=cascade,
+    )
+    stop = threading.Event()
+
+    def _drain(signum, frame) -> None:
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    tier.start()
+    print(json.dumps({
+        "serving": tier.address,
+        "retrieval_digest": retrieval.digest,
+        "ranking_digest": ranking.digest,
+        "k": cascade.k,
+        "topk_k": retrieval.engines[0].topk_k,
+        "index_items": int(len(retrieval.engines[0].item_index["item_index"])),
+        "replicas": args.replicas,
+    }, sort_keys=True), flush=True)
+    while not stop.wait(args.stats_every_s):
+        cascade.emit_stats()
+        retrieval.emit_stats()
+        ranking.emit_stats()
+    final = tier.close()
+    if logger is not None:
+        logger.close()
+    print(json.dumps({"drained": final}, sort_keys=True), flush=True)
+    return 0
+
+
 def cmd_loadgen(args) -> int:
     from xflow_tpu.obs.schema import load_jsonl, validate_rows
     from xflow_tpu.serve.loadgen import HttpTarget, run_loadgen
@@ -399,6 +546,56 @@ def main(argv: list[str] | None = None) -> int:
     )
     pv.add_argument("--watchdog-serve-s", type=float, default=10.0)
 
+    pi = sub.add_parser(
+        "index",
+        help="build the serve-time item index beside a retrieval "
+        "artifact from libffm item rows (docs/SERVING.md)",
+    )
+    common(pi)
+    pi.add_argument(
+        "--input", default="",
+        help="libffm file of item rows (default stdin); item-side "
+        "features (fields >= the artifact's tower_split_field) are "
+        "deduplicated into the catalog",
+    )
+    pi.add_argument(
+        "--max-items", type=int, default=0,
+        help="cap the catalog size (0 = no cap)",
+    )
+
+    pc = sub.add_parser(
+        "cascade",
+        help="retrieval→ranking cascade tier (docs/SERVING.md)",
+    )
+    pc.add_argument(
+        "retrieval",
+        help="retrieval artifact dir (two-tower family with an item "
+        "index — serve.artifact.export_item_index)",
+    )
+    pc.add_argument(
+        "ranking", help="ranking artifact dir (any point-score family)"
+    )
+    pc.add_argument("--num-devices", type=int, default=1)
+    pc.add_argument(
+        "--buckets", default="",
+        help="comma-separated batch-size buckets (default 1,8,64,512)",
+    )
+    fleet_args(pc)
+    pc.add_argument("--host", default="127.0.0.1")
+    pc.add_argument("--port", type=int, default=8000)
+    pc.add_argument(
+        "--k", type=int, default=8,
+        help="candidates retrieved and ranked per request",
+    )
+    pc.add_argument(
+        "--topk-k", type=int, default=None,
+        help="compiled top-k width on the retrieval engines "
+        "(default 16, capped at the index size); per-request k "
+        "slices it",
+    )
+    pc.add_argument("--canary-frac", type=float, default=0.1)
+    pc.add_argument("--stats-every-s", type=float, default=10.0)
+
     pl = sub.add_parser(
         "loadgen", help="open-loop zipf load generator (SLO rows)"
     )
@@ -422,6 +619,10 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_score(args)
     if args.cmd == "serve":
         return cmd_serve(args)
+    if args.cmd == "index":
+        return cmd_index(args)
+    if args.cmd == "cascade":
+        return cmd_cascade(args)
     if args.cmd == "loadgen":
         return cmd_loadgen(args)
     return cmd_bench(args)

@@ -44,6 +44,13 @@ from xflow_tpu.utils.checkpoint import all_ok, iter_owned_shards
 MANIFEST = "manifest.json"
 FORMAT = 1
 REMAP_FILE = "remap.npy"
+# serve-time item-embedding index (retrieval families — models with
+# user/item towers, models/two_tower.py): written ALONGSIDE an
+# exported artifact by export_item_index, read back by
+# PredictEngine.load for the top-k mode.  The meta file is the
+# presence marker and is written LAST (tmp + atomic replace per file),
+# so a crashed export never leaves a half-index that loads.
+ITEM_INDEX_META = "item_index.json"
 
 
 def servable_digest(config_digest: str, step: int) -> str:
@@ -191,6 +198,161 @@ def export_artifact(trainer, directory: str) -> str:
             raise err
         raise RuntimeError("artifact finalize failed on process 0")
     return directory
+
+
+def _atomic_save(directory: str, name: str, arr: np.ndarray) -> None:
+    tmp = os.path.join(directory, f".tmp-{name}")
+    with open(tmp, "wb") as f:  # file object: np.save never re-suffixes
+        np.save(f, arr)
+    os.replace(tmp, os.path.join(directory, name))
+
+
+def item_catalog_from_block(
+    block, split_field: int, max_items: int = 0
+) -> list[tuple]:
+    """Deduplicated item catalog in the featurize_raw row protocol
+    from one parsed libffm block: each sample's ITEM-side features
+    (slots >= ``split_field``) form a candidate, identified by its
+    sorted key set.  The ONE copy of the catalog-identity rule, shared
+    by the ``serve index`` CLI and the cascade smoke gate so the
+    shipped tool and the tier-1 gate cannot diverge."""
+    import numpy as np  # local: the module-level import exists; keep explicit
+
+    items: list[tuple] = []
+    seen: set[tuple] = set()
+    for i in range(block.num_samples):
+        lo, hi = int(block.row_ptr[i]), int(block.row_ptr[i + 1])
+        ks = block.keys[lo:hi].astype(np.int64)
+        ss = block.slots[lo:hi].astype(np.int32)
+        sel = ss >= split_field
+        ident = tuple(sorted(ks[sel]))
+        if ident and ident not in seen:
+            seen.add(ident)
+            items.append((ks[sel], ss[sel], None))
+        if max_items and len(items) >= max_items:
+            break
+    return items
+
+
+def export_item_index(
+    engine,
+    directory: str,
+    item_rows: list,
+    item_ids=None,
+) -> dict:
+    """Freeze the item-tower embeddings of a retrieval model into a
+    serve-time index inside an already-exported artifact directory.
+
+    ``item_rows`` is the catalog in the ``featurize_raw`` row protocol
+    (item-side features: slots in [tower_split_field, max_fields) and
+    raw hash-space keys); ``item_ids`` the external item identity per
+    row (default: the row ordinal).  ``engine`` must be a
+    PredictEngine loaded from — or digest-identical to — ``directory``
+    (a mismatched engine would bake embeddings from a different model
+    into this artifact's index).
+
+    Written files: ``item_index.npy`` [N, model.index_dim] embeddings
+    (tower_dim core + 2 bias lanes — the top-k scan operand),
+    ``item_ids.npy`` [N] int64, and the padded
+    raw feature planes ``item_keys/item_slots/item_vals.npy`` [N, nnz]
+    + ``item_nnz.npy`` [N] — the cascade (serve/cascade.py) reads
+    those to assemble user+candidate rows for the ranking stage.
+    Meta (``item_index.json``) carries count/dim/config digest and the
+    servable step, so a stale index against a re-exported artifact is
+    refused at load."""
+    manifest = load_manifest(directory)
+    if engine.digest != manifest["config_digest"]:
+        raise ValueError(
+            f"export_item_index: engine digest {engine.digest} != "
+            f"artifact {directory} digest {manifest['config_digest']} "
+            "— the index must be computed by the model it ships with"
+        )
+    if not hasattr(engine.model, "item_embed"):
+        raise ValueError(
+            f"model {engine.cfg.model!r} has no item tower "
+            "(models/__init__.py registry: retrieval=False) — only "
+            "two-tower-factored families export an item index"
+        )
+    n = len(item_rows)
+    if n < 1:
+        raise ValueError("export_item_index: empty item catalog")
+    emb = engine.item_embeddings(item_rows)  # [N, tower_dim]
+    ids = (
+        np.arange(n, dtype=np.int64)
+        if item_ids is None
+        else np.asarray(item_ids, dtype=np.int64)
+    )
+    if len(ids) != n:
+        raise ValueError(
+            f"export_item_index: {n} rows but {len(ids)} item_ids"
+        )
+    k = engine.cfg.max_nnz
+    keys = np.zeros((n, k), np.int64)
+    slots = np.zeros((n, k), np.int32)
+    vals = np.zeros((n, k), np.float32)
+    nnz = np.zeros(n, np.int32)
+    for i, row in enumerate(item_rows):
+        rk, rs, rv = row if isinstance(row, tuple) else (row, None, None)
+        rk = np.asarray(rk)
+        m = min(len(rk), k)
+        nnz[i] = m
+        keys[i, :m] = rk[:m]
+        if rs is not None:
+            slots[i, :m] = np.asarray(rs)[:m]
+        vals[i, :m] = 1.0 if rv is None else np.asarray(rv)[:m]
+    _atomic_save(directory, "item_index.npy", emb.astype(np.float32))
+    _atomic_save(directory, "item_ids.npy", ids)
+    _atomic_save(directory, "item_keys.npy", keys)
+    _atomic_save(directory, "item_slots.npy", slots)
+    _atomic_save(directory, "item_vals.npy", vals)
+    _atomic_save(directory, "item_nnz.npy", nnz)
+    meta = {
+        "count": n,
+        "dim": int(emb.shape[1]),
+        "nnz": int(k),
+        "config_digest": engine.digest,
+        "servable": engine.servable_digest,
+        "created_unix": round(time.time(), 3),
+    }
+    tmp = os.path.join(directory, ".tmp-" + ITEM_INDEX_META)
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=2)
+    os.replace(tmp, os.path.join(directory, ITEM_INDEX_META))
+    return meta
+
+
+def load_item_index(directory: str) -> dict | None:
+    """The index exported by :func:`export_item_index`, or None when
+    the artifact has no index.  Refuses (ValueError) an index whose
+    config digest does not match the artifact manifest — that is a
+    stale index left behind by a re-export under a different config,
+    and serving it would retrieve with the wrong geometry."""
+    path = os.path.join(directory, ITEM_INDEX_META)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        meta = json.load(f)
+    manifest = load_manifest(directory)
+    if meta.get("config_digest") != manifest["config_digest"]:
+        raise ValueError(
+            f"{directory}: item index was built for config "
+            f"{meta.get('config_digest')!r} but the artifact is "
+            f"{manifest['config_digest']!r} — re-run export_item_index "
+            "against the current artifact"
+        )
+    out = dict(meta)
+    for name in (
+        "item_index", "item_ids", "item_keys", "item_slots",
+        "item_vals", "item_nnz",
+    ):
+        out[name] = np.load(os.path.join(directory, f"{name}.npy"))
+    if out["item_index"].shape != (meta["count"], meta["dim"]):
+        raise ValueError(
+            f"{directory}: item_index.npy shape "
+            f"{out['item_index'].shape} does not match meta "
+            f"({meta['count']}, {meta['dim']})"
+        )
+    return out
 
 
 def load_manifest(directory: str) -> dict:

@@ -79,10 +79,23 @@ class MicroBatcher:
         metrics_logger=None,
         flight=None,
         emit_on_close: bool = True,
+        topk: bool = False,
     ):
         if max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
         self._engine = engine
+        # top-k mode (retrieval fleets, docs/SERVING.md cascade): the
+        # worker coalesces exactly like score mode but runs the
+        # engine's topk leg; each Future resolves to (item_ids [k],
+        # scores [k]) instead of a float.  One batcher serves ONE mode
+        # — a cascade runs a topk retrieval fleet in front of a score
+        # ranking fleet, so modes never mix inside a coalesced batch.
+        self._topk = topk
+        if topk and getattr(engine, "topk_k", 0) < 1:
+            raise ValueError(
+                "topk batcher needs an engine with an item index "
+                "attached (PredictEngine.attach_item_index)"
+            )
         # obs/flight.py heartbeat sink: one note_serve per coalesced
         # batch; a watchdog with set_pending("serve", self.pending)
         # then classifies silence-with-backlog as serve_queue_stall
@@ -341,7 +354,10 @@ class MicroBatcher:
             failpoint("serve.replica_score")
             batch = engine.featurize([row for row, _, _ in reqs])
             t1 = time.perf_counter()
-            pctr = engine.predict_prepared(batch)[: len(reqs)]
+            if self._topk:
+                ids, scores, _ = engine.topk_prepared(batch)
+            else:
+                pctr = engine.predict_prepared(batch)[: len(reqs)]
             t2 = time.perf_counter()
         except BaseException as e:  # resolve, never wedge the callers
             for _, fut, _ in reqs:
@@ -361,7 +377,16 @@ class MicroBatcher:
             reg.observe("serve.featurize_seconds", feat)
             reg.observe("serve.device_seconds", dev)
             reg.observe(f"serve.e2e.b{bucket}", t2 - t_enq)
-            fut.set_result(float(pctr[i]))
+            if self._topk:
+                # the scoring engine's index rides along: candidate
+                # ids are only meaningful against the index that
+                # produced them, and during a rollout canary different
+                # replicas serve different indexes — a consumer that
+                # read "the fleet's" index instead would resolve ids
+                # against the wrong catalog (serve/cascade.py)
+                fut.set_result((ids[i], scores[i], engine.item_index))
+            else:
+                fut.set_result(float(pctr[i]))
         reg.counter_add("serve.requests", len(reqs))
         reg.counter_add("serve.batches", 1.0)
         reg.observe("serve.batch_size", float(len(reqs)))

@@ -44,6 +44,11 @@ from xflow_tpu.obs import NULL_OBS
 from xflow_tpu.parallel.mesh import make_mesh, replicated, table_sharding
 
 DEFAULT_BUCKETS = (1, 8, 64, 512)
+# default top-k compile width for retrieval engines (attach_item_index):
+# the executable is compiled ONCE for this k (capped at the index
+# size); smaller request ks slice the result on the host, so mixed-k
+# traffic never compiles
+DEFAULT_TOPK = 16
 
 
 def _slice_rows(batch: Batch, start: int, stop: int) -> Batch:
@@ -153,8 +158,22 @@ class PredictEngine:
         # and counts compiles fleet-wide, exactly what the
         # no-recompile-under-any-traffic guarantee wants to watch.
         self._compiled: dict[tuple[int, int, int], Any] = {}
+        # retrieval-leg jit bindings (TrainStep idiom): _run_aot lowers
+        # THESE per bucket into _compiled (never retraces at serve
+        # time), and the explicit binding makes the impls visible to
+        # the static memory pass (shapeflow jit-entry discovery →
+        # XF014 budgets in memory-budget.json)
+        self.topk_jit = jax.jit(self._topk_impl)
+        self.item_embed_jit = jax.jit(self._item_embed_impl)
         self.warm_seconds = 0.0
         self._parse_fn = None
+        # serve-time item index (retrieval families, docs/SERVING.md
+        # "Retrieval→ranking cascade"): attached by ``load`` from the
+        # artifact's item_index.* files or by ``attach_item_index``;
+        # ``topk`` refuses until one is attached
+        self.item_index: dict | None = None
+        self._index_arr = None
+        self.topk_k = 0
         if warm:
             self.warm()
 
@@ -209,15 +228,20 @@ class PredictEngine:
         buckets: Sequence[int] | None = None,
         obs=None,
         warm: bool = True,
+        topk_k: int | None = None,
     ) -> "PredictEngine":
         """Load an exported artifact.  ``config``, when given, is the
         caller's expectation: its digest must equal the artifact's or
         the load is refused (never score through the wrong model).
         ``num_devices`` sizes the serving mesh (default 1 — the lean
         scoring tier; the row-range shard files assemble onto any
-        mesh)."""
+        mesh).  An item index beside the artifact (export_item_index)
+        is attached automatically, arming the ``topk`` mode compiled
+        for ``topk_k`` results (default ``DEFAULT_TOPK``, capped at
+        the index size)."""
         from xflow_tpu.serve.artifact import (
             REMAP_FILE,
+            load_item_index,
             load_manifest,
         )
         from xflow_tpu.utils.checkpoint import RangeReader
@@ -265,7 +289,7 @@ class PredictEngine:
             "dense": dense,
             "step": jnp.asarray(manifest["step"], jnp.int32),
         }
-        return cls(
+        engine = cls(
             cfg,
             state,
             remap=remap,
@@ -273,8 +297,14 @@ class PredictEngine:
             buckets=buckets,
             obs=obs,
             digest=digest,
-            warm=warm,
+            warm=False,  # warm AFTER the index attach so topk buckets warm too
         )
+        index = load_item_index(directory)
+        if index is not None:
+            engine.attach_item_index(index, topk_k=topk_k)
+        if warm:
+            engine.warm()
+        return engine
 
     def clone(self) -> "PredictEngine":
         """A replica view over the SAME weights and the SAME compiled
@@ -304,6 +334,11 @@ class PredictEngine:
         replica._compiled = self._compiled
         replica._servable_step = self._servable_step
         replica.warm_seconds = self.warm_seconds
+        # item index: host planes and the device scan operand are
+        # immutable once attached — shared like the weights
+        replica.item_index = self.item_index
+        replica._index_arr = self._index_arr
+        replica.topk_k = self.topk_k
         return replica
 
     @staticmethod
@@ -331,10 +366,15 @@ class PredictEngine:
     def warm(self) -> float:
         """Compile every bucket now (one all-padding batch each) so the
         first real request never pays an XLA compile; returns and
-        records the warmup seconds."""
+        records the warmup seconds.  Retrieval engines (item index
+        attached) warm the top-k executables the same way — after
+        warm, ``compile_count`` covers BOTH modes and must stay there
+        under any single-row/top-k traffic mix."""
         t0 = time.perf_counter()
         for b in self.buckets:
             self.predict(self._empty_batch(b))
+            if self._index_arr is not None:
+                self.topk(self._empty_batch(b))
         self.warm_seconds = time.perf_counter() - t0
         return self.warm_seconds
 
@@ -435,6 +475,176 @@ class PredictEngine:
             e = min(s + cap, n)
             raw = pack_batch(block, s, e, e - s, self.cfg.max_nnz)
             out.append(self.predict(raw))
+        return np.concatenate(out)
+
+    # -- retrieval: item index + top-k --------------------------------------
+
+    def attach_item_index(
+        self, index: dict, topk_k: int | None = None
+    ) -> None:
+        """Arm the top-k mode with an item-embedding index
+        (serve/artifact.py::load_item_index's dict, or any dict with
+        ``item_index`` [N, D] / ``item_ids`` [N] plus the feature
+        planes).  The scan operand goes to the device once,
+        replicated; ``topk_k`` fixes the compiled result width
+        (DEFAULT_TOPK, capped at N)."""
+        from xflow_tpu.parallel.mesh import replicated
+
+        if not hasattr(self.model, "user_embed"):
+            raise ValueError(
+                f"model {self.cfg.model!r} has no user tower "
+                "(models/__init__.py registry: retrieval=False) — "
+                "top-k retrieval needs a two-tower-factored family"
+            )
+        emb = np.asarray(index["item_index"], np.float32)
+        if emb.ndim != 2 or not len(emb):
+            raise ValueError(
+                f"item index must be [N, index_dim], got {emb.shape}"
+            )
+        want = getattr(self.model, "index_dim", None)
+        if want is not None and emb.shape[1] != want:
+            raise ValueError(
+                f"item index rows are {emb.shape[1]} wide but model "
+                f"{self.cfg.model!r} scans {want} lanes (tower_dim "
+                f"{self.cfg.tower_dim} + 2 bias lanes) — the index was "
+                "exported from a different tower geometry; re-run "
+                "export_item_index"
+            )
+        # own copy + the precomputed id sort order: the cascade's
+        # per-request id->row resolution must not pay an O(N log N)
+        # argsort over the catalog on the retrieval worker thread
+        self.item_index = dict(index)
+        self.item_index["ids_order"] = np.argsort(
+            np.asarray(index["item_ids"]), kind="stable"
+        )
+        self._index_arr = jax.device_put(emb, replicated(self.mesh))
+        self.topk_k = min(
+            topk_k if topk_k is not None else DEFAULT_TOPK, len(emb)
+        )
+        if self.topk_k < 1:
+            raise ValueError("topk_k must be >= 1")
+
+    def _topk_impl(self, state, index, arrays):
+        """User-tower pass + dot-product scan + device top-k — the
+        whole retrieval scoring path as ONE jitted program (AOT per
+        bucket like predict).  ``index`` [N, D] rides as an argument,
+        so a rollout's new index needs zero recompiles."""
+        batch = self.step._expand_wire(arrays)
+        for k in ("cold_uidx", "cold_tail_keys", "cold_dict_keys"):
+            batch.pop(k, None)  # no scatter to plan for
+        rows = self.step._gather_model_rows(state["tables"], batch)
+        u = self.model.user_embed(
+            rows, self.step._model_view(batch), state["dense"]
+        )  # [B, D]
+        scores = u @ index.T  # [B, N]
+        vals, idx = jax.lax.top_k(scores, self.topk_k)
+        return vals, idx, u
+
+    def _item_embed_impl(self, state, arrays):
+        """Item-tower pass [B, D] — export_item_index's batch leg."""
+        batch = self.step._expand_wire(arrays)
+        for k in ("cold_uidx", "cold_tail_keys", "cold_dict_keys"):
+            batch.pop(k, None)
+        rows = self.step._gather_model_rows(state["tables"], batch)
+        return self.model.item_embed(
+            rows, self.step._model_view(batch), state["dense"]
+        )
+
+    def _run_aot(self, tag: str, jitted, batch: Batch, extra=()):
+        """Compile-once-per-bucket execution shared by the topk and
+        item-embed legs (predict_prepared keeps its own body — its
+        multi-host gather and compact re-validation don't apply
+        here).  ``extra`` arrays ride as leading executable arguments
+        after state."""
+        key = (tag, self.topk_k, batch.batch_size, batch.max_nnz,
+               batch.hot_nnz)
+        arrays = self.step.put_batch(batch, predict=True)
+        exe = self._compiled.get(key)
+        if exe is None:
+            with self.obs.phase("serve_compile"):
+                exe = jitted.lower(
+                    self.state, *extra, arrays
+                ).compile()
+            self._compiled[key] = exe
+            self.obs.counter("serve.compiles")
+        with self.obs.phase("serve_execute"):
+            out = exe(self.state, *extra, arrays)
+            out = jax.tree.map(
+                lambda a: np.asarray(jax.device_get(a)), out
+            )
+        if self.obs.flight is not None:
+            self.obs.flight.note_serve(f"{tag}:b{batch.batch_size}")
+        return out
+
+    def topk_prepared(
+        self, batch: Batch
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(item_ids [B, k], scores [B, k], user_emb [B, D]) for one
+        already-prepared bucket-sized batch — the batcher's top-k leg.
+        ``user_emb`` is returned so parity checks (the cascade smoke
+        gate's numpy full-scan argsort) can verify the device scan
+        independently."""
+        if self._index_arr is None:
+            raise ValueError(
+                "top-k refused: no item index attached — export one "
+                "with serve.artifact.export_item_index (retrieval "
+                "families only) or attach_item_index(...)"
+            )
+        vals, idx, u = self._run_aot(
+            "topk", self.topk_jit, batch, extra=(self._index_arr,)
+        )
+        ids = self.item_index["item_ids"][idx]
+        return ids, vals, u
+
+    def topk(
+        self, batch: Batch, k: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """(item_ids [B, k], scores [B, k]) for an externally built
+        raw-key-space batch of USER-side features.  Any batch size
+        (pad/chunk like ``predict``); any ``k <= topk_k`` slices the
+        one compiled result width — mixed-k traffic never compiles."""
+        kk = self.topk_k if k is None else int(k)
+        if kk < 1 or kk > self.topk_k:
+            raise ValueError(
+                f"k={kk} outside (0, topk_k={self.topk_k}] — the "
+                "engine compiles ONE top-k width; raise topk_k at "
+                "load/attach time for deeper candidate sets"
+            )
+        n = batch.batch_size
+        batch = self._prepare(batch)
+        cap = self.buckets[-1]
+        ids_out, score_out = [], []
+        for s in range(0, n, cap):
+            e = min(s + cap, n)
+            chunk = pad_batch_rows(
+                _slice_rows(batch, s, e), self.bucket_for(e - s)
+            )
+            ids, vals, _ = self.topk_prepared(chunk)
+            ids_out.append(ids[: e - s, :kk])
+            score_out.append(vals[: e - s, :kk])
+        return np.concatenate(ids_out), np.concatenate(score_out)
+
+    def item_embeddings(self, rows: Sequence) -> np.ndarray:
+        """Item-tower embeddings [len(rows), model.index_dim] (the
+        tower_dim core lanes + the two bias-augmentation lanes,
+        models/two_tower.py) for featurize_raw-protocol catalog rows —
+        export_item_index's compute leg, bucket-chunked through the
+        same AOT path."""
+        if not hasattr(self.model, "item_embed"):
+            raise ValueError(
+                f"model {self.cfg.model!r} has no item tower "
+                "(registry: retrieval=False)"
+            )
+        cap = self.buckets[-1]
+        out = []
+        for s in range(0, len(rows), cap):
+            chunk = rows[s : s + cap]
+            b = pad_batch_rows(
+                self._prepare(self.featurize_raw(chunk)),
+                self.bucket_for(len(chunk)),
+            )
+            emb = self._run_aot("item_embed", self.item_embed_jit, b)
+            out.append(emb[: len(chunk)])
         return np.concatenate(out)
 
     # -- predict -----------------------------------------------------------

@@ -140,6 +140,7 @@ class ReplicaFleet:
         registry: MetricsRegistry | None = None,
         evict_after_errors: int = 3,
         revive: bool = True,
+        topk: bool = False,
     ):
         if replicas < 1:
             raise ValueError("a fleet needs at least 1 replica")
@@ -149,6 +150,11 @@ class ReplicaFleet:
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics_logger = metrics_logger
         self.flight = flight
+        # top-k fleet (the cascade's retrieval stage): every replica
+        # batcher runs the engine's topk leg; submit() Futures resolve
+        # to (item_ids, scores).  Mode is fleet-wide — one fleet, one
+        # endpoint semantics.
+        self.topk = topk
         self.engines = [engine] + [
             engine.clone() for _ in range(replicas - 1)
         ]
@@ -161,6 +167,7 @@ class ReplicaFleet:
                 metrics_logger=None,  # the fleet owns the stats rows
                 flight=flight,
                 emit_on_close=False,
+                topk=topk,
             )
             for e in self.engines
         ]
@@ -217,10 +224,14 @@ class ReplicaFleet:
         buckets: Sequence[int] | None = None,
         obs=None,
         warm: bool = True,
+        topk_k: int | None = None,
         **kw,
     ) -> "ReplicaFleet":
         """Load one artifact from the shared store and fan it out to
-        ``replicas`` clones (one compile set, shared weights)."""
+        ``replicas`` clones (one compile set, shared weights).
+        ``topk_k`` sizes the compiled top-k width for retrieval
+        artifacts (engine.load attaches their item index either
+        way)."""
         from xflow_tpu.serve.engine import PredictEngine
 
         engine = PredictEngine.load(
@@ -229,6 +240,7 @@ class ReplicaFleet:
             buckets=buckets,
             obs=obs,
             warm=warm,
+            topk_k=topk_k,
         )
         fleet = cls(engine, replicas, **kw)
         # rollouts load candidates the same way this fleet was loaded
@@ -236,6 +248,7 @@ class ReplicaFleet:
             "num_devices": num_devices,
             "buckets": buckets,
             "obs": obs,
+            "topk_k": topk_k,
         }
         fleet.log_load(artifact)
         return fleet
@@ -571,6 +584,14 @@ class ReplicaFleet:
                     "first)"
                 )
         candidate = self._load_candidate(artifact)
+        if self.topk and getattr(candidate, "topk_k", 0) < 1:
+            raise ValueError(
+                "rollout refused: this is a top-k fleet but the "
+                "candidate artifact has no item index — run "
+                "serve.artifact.export_item_index on it first (a "
+                "candidate that cannot answer top-k would evict every "
+                "replica it reaches)"
+            )
         if not force and candidate.digest != self.digest:
             raise ValueError(
                 f"rollout refused: candidate digest {candidate.digest} "

@@ -178,7 +178,8 @@ class _TierServer(ThreadingHTTPServer):
         # http threshold should commit via POST /v1/rollout/commit
         # (handler thread) instead of auto_commit.
         try:
-            tier.fleet.rollout_tick()
+            for fleet in tier.fleets():
+                fleet.rollout_tick()
         except Exception as e:
             # a failing transition (clone OOM, logger I/O) must not
             # unwind serve_forever and turn a rollout problem into a
@@ -310,16 +311,27 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/healthz":
                 fleet = self.tier.fleet
-                self._json(200, {
+                doc = {
                     "status": "serving",
                     "digest": fleet.digest,
                     "model": fleet.cfg.model,
                     "replicas": fleet.replicas,
                     "depth": fleet.depth(),
                     "rollout": fleet.rollout_state(),
-                })
+                }
+                casc = self.tier.cascade
+                if casc is not None:
+                    doc["cascade"] = {
+                        "retrieval_digest": casc.retrieval.digest,
+                        "ranking_digest": casc.ranking.digest,
+                        "k": casc.k,
+                    }
+                self._json(200, doc)
             elif self.path == "/v1/stats":
-                self._json(200, self.tier.fleet.stats())
+                doc = self.tier.fleet.stats()
+                if self.tier.cascade is not None:
+                    doc["cascade"] = self.tier.cascade.stats()
+                self._json(200, doc)
             else:
                 self._json(404, {"error": f"no such path {self.path}"})
         except ConnectionError:
@@ -337,6 +349,113 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             self.tier._handler_exit()
 
+    def _rollout_fleet(self, doc: dict):
+        """The fleet a rollout request targets: ``stage`` routes to a
+        cascade stage ("retrieval"/"ranking"); default is the tier's
+        primary fleet — either stage rolls out INDEPENDENTLY through
+        its own canary gate."""
+        stage = doc.get("stage")
+        if stage is None:
+            return self.tier.fleet
+        casc = self.tier.cascade
+        if casc is None:
+            raise ValueError(
+                f"stage {stage!r} given but this tier serves no "
+                "cascade"
+            )
+        if stage == "retrieval":
+            return casc.retrieval
+        if stage == "ranking":
+            return casc.ranking
+        raise ValueError(
+            f"unknown stage {stage!r} (want 'retrieval' or 'ranking')"
+        )
+
+    @staticmethod
+    def _request_k(doc) -> int | None:
+        """Validated optional per-request k (400 on garbage — a
+        non-numeric k must not surface as a 500 TypeError)."""
+        k = doc.get("k") if isinstance(doc, dict) else None
+        if k is None:
+            return None
+        try:
+            k = int(k)
+        except (TypeError, ValueError):
+            raise ValueError(f"bad k: {k!r}") from None
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        return k
+
+    @staticmethod
+    def _request_rows(doc) -> list[tuple]:
+        """Validated rows from a JSON body — the _handle_score_json
+        client-garbage contract (400, never 500, on malformed input),
+        shared by the topk/recommend endpoints."""
+        if not isinstance(doc, dict):
+            raise ValueError(
+                "request body must be a JSON object "
+                '({"rows": [...]} or one row {"keys": [...]})'
+            )
+        raw = doc["rows"] if "rows" in doc else [doc]
+        if not isinstance(raw, list):
+            raise ValueError('"rows" must be a list of row objects')
+        rows = []
+        for r in raw:
+            if not isinstance(r, dict):
+                raise ValueError('each row must be an object with "keys"')
+            try:
+                rows.append((
+                    np.asarray(r["keys"], dtype=np.int64),
+                    np.asarray(r["slots"], dtype=np.int32)
+                    if r.get("slots") is not None else None,
+                    np.asarray(r["vals"], dtype=np.float32)
+                    if r.get("vals") is not None else None,
+                ))
+            except TypeError as e:
+                # np.asarray raises TypeError on ragged/object fields
+                # — a client problem, not a server fault (400 not 500)
+                raise ValueError(f"bad row field: {e}") from None
+        return rows
+
+    def _handle_topk(self, body: bytes) -> None:
+        """Top-k retrieval over the tier's topk fleet: rows of
+        USER-side features -> per-row candidate ids + dot scores."""
+        fleet = self.tier.topk_fleet()
+        doc = json.loads(body.decode())
+        rows = self._request_rows(doc)
+        k = self._request_k(doc)
+        futs = [fleet.submit(*row) for row in rows]
+        deadline = time.perf_counter() + SCORE_TIMEOUT_S
+        items, scores = [], []
+        for f in futs:
+            ids, sc, _ = f.result(  # 3rd: the producing index (cascade's)
+                timeout=max(0.001, deadline - time.perf_counter())
+            )
+            if k is not None:
+                ids, sc = ids[:k], sc[:k]
+            items.append([int(i) for i in ids])
+            scores.append([round(float(s), 6) for s in sc])
+        self._json(200, {
+            "items": items,
+            "scores": scores,
+            "digest": fleet.digest,
+        })
+
+    def _handle_recommend(self, body: bytes) -> None:
+        """The cascade front door: USER features -> retrieval top-k ->
+        ranked candidates (serve/cascade.py)."""
+        casc = self.tier.cascade
+        if casc is None:
+            raise ValueError("this tier serves no cascade")
+        doc = json.loads(body.decode())
+        rows = self._request_rows(doc)
+        if len(rows) != 1:
+            raise ValueError(
+                f"recommend takes exactly one row, got {len(rows)}"
+            )
+        result = casc.recommend(*rows[0], k=self._request_k(doc))
+        self._json(200, result)
+
     def _do_post(self) -> None:
         try:
             body = self._body()
@@ -344,9 +463,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._handle_score_json(body)
             elif self.path == "/v1/score_packed":
                 self._handle_score_packed(body)
+            elif self.path == "/v1/topk":
+                self._handle_topk(body)
+            elif self.path == "/v1/recommend":
+                self._handle_recommend(body)
             elif self.path == "/v1/rollout":
                 doc = json.loads(body.decode()) if body else {}
-                state = self.tier.fleet.begin_rollout(
+                state = self._rollout_fleet(doc).begin_rollout(
                     doc["artifact"],
                     canary_frac=float(doc.get(
                         "canary_frac", self.tier.default_canary_frac
@@ -362,12 +485,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"rollout": state})
             elif self.path == "/v1/rollout/commit":
                 doc = json.loads(body.decode()) if body else {}
-                health = self.tier.fleet.commit_rollout(
+                health = self._rollout_fleet(doc).commit_rollout(
                     force=bool(doc.get("force", False))
                 )
                 self._json(200, {"committed": health})
             elif self.path == "/v1/rollout/abort":
-                health = self.tier.fleet.abort_rollout(detail="api")
+                doc = json.loads(body.decode()) if body else {}
+                health = self._rollout_fleet(doc).abort_rollout(
+                    detail="api"
+                )
                 self._json(200, {"aborted": health})
             else:
                 self._json(404, {"error": f"no such path {self.path}"})
@@ -405,8 +531,17 @@ class ServeTier:
         poll_s: float = 0.25,
         drain_timeout_s: float = 30.0,
         default_canary_frac: float = 0.1,
+        cascade=None,
     ):
         self.fleet = fleet
+        # retrieval→ranking cascade (serve/cascade.py): when set, the
+        # tier additionally serves /v1/topk (the cascade's retrieval
+        # fleet) and /v1/recommend, and rollout endpoints accept a
+        # ``stage`` selector.  ``fleet`` stays the primary point-score
+        # surface — conventionally the cascade's ranking fleet, so
+        # /v1/score traffic and cascade traffic share replicas the
+        # way mixed production traffic would.
+        self.cascade = cascade
         self.flight = flight
         self.default_canary_frac = default_canary_frac
         # survived serve.accept failpoint fires (written only from the
@@ -423,6 +558,30 @@ class ServeTier:
         # live handler-thread count (daemon handlers are NOT joined by
         # server_close — see _TierServer); close() drains on this
         self._inflight = 0
+
+    def fleets(self) -> list:
+        """Every fleet this tier fronts (primary + cascade stages,
+        deduped by identity) — the accept loop ticks each one's auto
+        rollout."""
+        out = [self.fleet]
+        if self.cascade is not None:
+            for f in (self.cascade.retrieval, self.cascade.ranking):
+                if all(f is not g for g in out):
+                    out.append(f)
+        return out
+
+    def topk_fleet(self) -> ReplicaFleet:
+        """The fleet behind /v1/topk: the cascade's retrieval stage,
+        or the primary fleet when it is itself a topk fleet."""
+        if self.cascade is not None:
+            return self.cascade.retrieval
+        if getattr(self.fleet, "topk", False):
+            return self.fleet
+        raise ValueError(
+            "this tier serves no top-k fleet (load a retrieval "
+            "artifact with ReplicaFleet(..., topk=True) or front a "
+            "cascade)"
+        )
 
     def _handler_enter(self) -> None:
         with self._lock:
@@ -512,6 +671,12 @@ class ServeTier:
             and time.perf_counter() < deadline
         ):
             time.sleep(0.01)
+        if self.cascade is not None:
+            # cascade drains retrieval→ranking in order (its in-flight
+            # fan-outs must land before the ranking queues close);
+            # fleet.close() below is then idempotent if the primary
+            # fleet IS a cascade stage
+            self.cascade.close()
         final = self.fleet.close()
         with self._lock:
             self._final_rows = final

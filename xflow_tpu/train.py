@@ -35,10 +35,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--config", help="JSON config file (flags override it)")
     p.add_argument("--train", dest="train_path", help="train shard prefix")
     p.add_argument("--test", dest="test_path", help="test shard prefix")
+    from xflow_tpu.models import model_names
+
     p.add_argument(
         "--model",
-        choices=["lr", "fm", "mvm", "ffm", "wide_deep", "0", "1", "2"],
-        help="model family (numeric aliases match the reference argv[3])",
+        choices=[*model_names(), "0", "1", "2"],
+        help="model family (registry: models/__init__.py; numeric "
+        "aliases match the reference argv[3])",
     )
     p.add_argument("--epochs", type=int)
     p.add_argument("--optimizer", choices=["ftrl", "sgd"])
@@ -48,6 +51,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ffm-v-dim", type=int, dest="ffm_v_dim")
     p.add_argument("--emb-dim", type=int, dest="emb_dim")
     p.add_argument("--hidden-dim", type=int, dest="hidden_dim")
+    p.add_argument(
+        "--tower-split-field", type=int, dest="tower_split_field",
+        help="two_tower: fields < split are user-side, >= item-side",
+    )
+    p.add_argument(
+        "--tower-dim", type=int, dest="tower_dim",
+        help="two_tower: tower output width (= item-index row width)",
+    )
+    p.add_argument(
+        "--cross-layers", type=int, dest="cross_layers",
+        help="dcn: explicit cross-network depth",
+    )
     p.add_argument("--max-nnz", type=int, dest="max_nnz")
     p.add_argument("--max-fields", type=int, dest="max_fields")
     p.add_argument("--block-mib", type=int, dest="block_mib")
