@@ -2,14 +2,22 @@
 ``BENCH_r*.json`` against the best prior run via ``python -m
 xflow_tpu.obs compare --fail-on-regress``.
 
+Two metrics gate:
+
+* the train metric (``value``) against the best non-degraded prior;
+* ``e2e_packed_examples_per_sec`` — the packed input-path throughput
+  the fan-out work (ISSUE 14 / ROADMAP 1) optimizes — against the best
+  non-degraded prior that MEASURES it (older artifacts predate the
+  metric; a degraded round never becomes either bar).
+
 The committed bench artifacts accumulated for five PRs without ever
 gating anything; this script turns the trajectory into a signal.  It
 is WARN-ONLY by default (exit 0 with a loud message): the containers
 the tier-1 suite runs in are routinely degraded (CPU backend,
-``degraded: true`` in the artifact), so a hard gate would fail on
-environment, not on code.  ``--strict`` makes a regression (or a
-missing baseline) exit non-zero for environments where the numbers are
-trustworthy.
+``degraded: true`` in the artifact) and wildly different in core
+count, so a hard gate would fail on environment, not on code.
+``--strict`` makes a regression (or a missing baseline) exit non-zero
+for environments where the numbers are trustworthy.
 
 Run from the repo root:
 
@@ -53,8 +61,13 @@ def main(argv: list[str] | None = None) -> int:
     from xflow_tpu.obs.__main__ import main as obs_main
     from xflow_tpu.obs.summary import load_bench_result
 
-    paths = find_bench_artifacts(args.root)
-    usable = [p_ for p_ in paths if load_bench_result(p_) is not None]
+    # one read per artifact: every later filter/lookup goes through
+    # this memo (an artifact rewritten mid-run can't be seen in two
+    # different states)
+    results = {
+        p_: load_bench_result(p_) for p_ in find_bench_artifacts(args.root)
+    }
+    usable = [p_ for p_, r in results.items() if r is not None]
     if len(usable) < 2:
         print(
             f"SKIP: {len(usable)} usable bench artifact(s) under "
@@ -69,8 +82,7 @@ def main(argv: list[str] | None = None) -> int:
     # degraded (a whole stretch of broken tunnels) fall back to all of
     # them rather than skipping the check entirely.
     priors = [
-        p_ for p_ in usable[:-1]
-        if not load_bench_result(p_).get("degraded")
+        p_ for p_ in usable[:-1] if not results[p_].get("degraded")
     ]
     if not priors:
         print(
@@ -78,29 +90,59 @@ def main(argv: list[str] | None = None) -> int:
             "comparing against degraded baselines"
         )
         priors = usable[:-1]
-    best_prior = max(
-        priors,
-        key=lambda p_: float(load_bench_result(p_)["value"]),
-    )
+    best_prior = max(priors, key=lambda p_: float(results[p_]["value"]))
     print(f"comparing latest {latest} against best prior {best_prior}:")
     rc = obs_main([
         "compare", "--fail-on-regress", str(args.frac), best_prior, latest,
     ])
+    regressions = []
     if rc == 3:
-        msg = (
+        regressions.append(
             f"bench regression: {latest} fell more than "
             f"{100 * args.frac:.0f}% below {best_prior}"
         )
-        if args.strict:
-            print(f"FAIL: {msg}", file=sys.stderr)
-            return 1
-        print(f"WARN (non-gating): {msg}", file=sys.stderr)
-        return 0
-    if rc != 0:
+    elif rc != 0:
         print(f"FAIL: obs compare exited {rc}", file=sys.stderr)
         return rc
-    print(f"OK: {latest} within {100 * args.frac:.0f}% of {best_prior}")
-    return 0
+    else:
+        print(f"OK: {latest} within {100 * args.frac:.0f}% of {best_prior}")
+
+    # secondary gate: the packed input-path metric.  Its baseline is
+    # chosen among priors that HAVE it (it postdates the early rounds),
+    # still skipping degraded ones.
+    e2e = "e2e_packed_examples_per_sec"
+    latest_e2e = results[latest].get(e2e)
+    e2e_priors = [p_ for p_ in priors if results[p_].get(e2e)]
+    if latest_e2e and e2e_priors:
+        best_e2e = max(e2e_priors, key=lambda p_: float(results[p_][e2e]))
+        a = float(results[best_e2e][e2e])
+        b = float(latest_e2e)
+        drop = (a - b) / a if a > 0 else 0.0
+        if drop > args.frac:
+            regressions.append(
+                f"input-path regression: {latest} {e2e}={b:.0f} is "
+                f"{100 * drop:.1f}% below {best_e2e} ({a:.0f})"
+            )
+        else:
+            print(
+                f"OK: {e2e} {b:.0f} within {100 * args.frac:.0f}% of "
+                f"best prior {best_e2e} ({a:.0f})"
+            )
+    elif not latest_e2e and e2e_priors:
+        # priors measure the metric but the latest doesn't: the e2e
+        # bench leg broke or was skipped — the gate must not silently
+        # stop measuring the very metric it exists to protect
+        regressions.append(
+            f"missing metric: latest artifact {latest} has no {e2e} "
+            "while prior artifacts measure it — the e2e packed bench "
+            "leg did not run"
+        )
+    for msg in regressions:
+        if args.strict:
+            print(f"FAIL: {msg}", file=sys.stderr)
+        else:
+            print(f"WARN (non-gating): {msg}", file=sys.stderr)
+    return 1 if (regressions and args.strict) else 0
 
 
 if __name__ == "__main__":

@@ -57,11 +57,95 @@ def trainer(toy_dataset):
         max_nnz=24,
         num_devices=1,
         epochs=1,
-        transfer_ahead=2,
+        transfer_ahead_depth=2,
     )
     t = Trainer(cfg)
     yield t
     t.close()
+
+
+def test_ring_depths_bitwise_equal_batch_streams(trainer):
+    """Depths 1, 2 and 4 stage the identical (arrays, shard, resume)
+    sequence — the deep ring reorders WORK, never batches, so training
+    is bitwise-independent of Config.transfer_ahead_depth."""
+    import jax
+    import numpy as np
+
+    def collect(depth):
+        out = []
+        stream = trainer._transfer_ahead(
+            trainer.iter_train_batches(), depth=depth
+        )
+        trainer._live_transfer.add(stream)
+        try:
+            for arrays, si, resume in stream:
+                out.append((si, resume, jax.device_get(arrays)))
+        finally:
+            trainer._live_transfer.discard(stream)
+            stream.close()
+        return out
+
+    base = collect(1)
+    assert len(base) > 3
+    for depth in (2, 4):
+        got = collect(depth)
+        assert len(got) == len(base)
+        for (sa, ra, aa), (sb, rb, ab) in zip(base, got):
+            assert (sa, ra) == (sb, rb)
+            assert sorted(aa) == sorted(ab)
+            for k in aa:
+                assert np.array_equal(
+                    np.asarray(aa[k]), np.asarray(ab[k])
+                ), k
+
+
+def test_worker_exception_mid_ring_deep(toy_dataset):
+    """A worker raising mid-ring at depth 4 (several in-flight futures
+    on multiple workers) propagates, close() stays bounded, and no ring
+    thread outlives it — the depth-2 contract holds at depth."""
+    cfg = Config(
+        model="lr",
+        train_path=toy_dataset.train_prefix,
+        batch_size=16,
+        table_size_log2=14,
+        max_nnz=24,
+        num_devices=1,
+        epochs=1,
+        transfer_ahead_depth=4,
+    )
+    t = Trainer(cfg)
+    before = _ring_threads()
+    orig = t.step.put_batch
+    calls = []
+
+    def boom(batch):
+        calls.append(1)
+        if len(calls) == 5:
+            raise RuntimeError("worker exploded mid-deep-ring")
+        return orig(batch)
+
+    t.step.put_batch = boom
+    try:
+        with pytest.raises(RuntimeError, match="mid-deep-ring"):
+            t.train_epoch()
+        t0 = time.time()
+        t.close()
+        assert time.time() - t0 < 30, "close() stalled after ring failure"
+        _wait_no_new_ring_threads(before)
+    finally:
+        t.step.put_batch = orig
+        t.close()
+
+
+def test_ring_worker_scaling():
+    """_ring_workers: 1 at depth 1, >= 2 once double buffering is
+    possible, never more workers than ring slots."""
+    from xflow_tpu.trainer import _ring_workers
+
+    assert _ring_workers(1) == 1
+    assert _ring_workers(2) == 2
+    for depth in (2, 3, 4, 8):
+        assert 2 <= _ring_workers(depth) <= depth
 
 
 def test_worker_exception_mid_ring_no_deadlock(trainer):

@@ -409,8 +409,24 @@ class Config:
     # overlapping link round-trips and compaction with device compute
     # (trainer._transfer_ahead; single-host only — multi-host transfers
     # are collective).  >= 2 keeps the link busy while a transfer is in
-    # flight (double buffering); raise it on high-latency links.
-    transfer_ahead: int = 2
+    # flight (double buffering); deeper rings absorb link-latency jitter
+    # and give the N-stream input fan-out (input_streams, io/fanout.py)
+    # room to stay ahead of the device.  Worker count scales with the
+    # depth (capped by the host's cores); batch order is preserved at
+    # any depth (docs/PERF.md "Input fan-out").
+    transfer_ahead_depth: int = 2
+
+    # Parallel sharded input fan-out (io/fanout.py; docs/PERF.md "Input
+    # fan-out"): number of concurrent shard-reader streams feeding the
+    # training loop.  Stream s owns the epoch's shards with index
+    # i % input_streams == s and runs its own read -> parse -> compact
+    # worker, so per-shard host work no longer serializes behind one
+    # stream; the merged batch order is the SERIAL shard order (stream
+    # interleave keyed by shard index), so training is bitwise-identical
+    # to input_streams=1.  1 = the serial path.  Most effective with
+    # multi-shard epochs; a single-shard epoch degrades to one stream.
+    # store_mode='tiered' requires 1 (see __post_init__).
+    input_streams: int = 1
 
     def __post_init__(self) -> None:
         # registry-validated (models/__init__.py): new families become
@@ -552,8 +568,28 @@ class Config:
             raise ValueError("max_quarantined_frac must be in [0, 1]")
         if self.checkpoint_keep < 0:
             raise ValueError("checkpoint_keep must be >= 0")
-        if self.transfer_ahead < 1:
-            raise ValueError("transfer_ahead must be >= 1")
+        if self.transfer_ahead_depth < 1:
+            raise ValueError(
+                "transfer_ahead_depth must be >= 1 (1 = a single staged "
+                "batch; >= 2 overlaps transfer with device compute)"
+            )
+        if self.input_streams < 1:
+            raise ValueError(
+                "input_streams must be >= 1 (1 = the serial reader; "
+                "N > 1 fans the shard list out over N concurrent "
+                "streams — io/fanout.py)"
+            )
+        if self.input_streams > 1 and self.store_mode == "tiered":
+            raise ValueError(
+                "input_streams > 1 does not compose with "
+                "store_mode='tiered' yet: the cold store's strict "
+                "plan->dispatch->writeback ordering (read-your-writes, "
+                "docs/STORE.md) already pins the transfer-ahead ring "
+                "off, and concurrent shard streams would feed it no "
+                "faster — set input_streams=1; the async-PS per-key-"
+                "range version gate of ROADMAP item 2 is the relaxation "
+                "that lifts this pin"
+            )
         if self.obs_trace_capacity < 1:
             raise ValueError("obs_trace_capacity must be >= 1")
         if self.obs_flight_events < 1:
@@ -598,6 +634,11 @@ class Config:
     @classmethod
     def from_json(cls, text: str) -> "Config":
         raw: dict[str, Any] = json.loads(text)
+        # legacy alias (docs/MIGRATION.md): checkpoint/artifact manifests
+        # written before the input fan-out spelled the staging-ring depth
+        # `transfer_ahead`
+        if "transfer_ahead" in raw and "transfer_ahead_depth" not in raw:
+            raw["transfer_ahead_depth"] = raw.pop("transfer_ahead")
         fields = {f.name for f in dataclasses.fields(cls)}
         unknown = set(raw) - fields
         if unknown:

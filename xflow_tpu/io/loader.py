@@ -22,6 +22,7 @@ from __future__ import annotations
 import math
 import queue
 import threading
+import time
 import warnings
 from typing import Callable, Iterator
 
@@ -479,6 +480,12 @@ class _PrefetchIter:
         self._close_lock = threading.Lock()
         self._obs = obs if obs is not None else NULL_OBS
         self._thread: threading.Thread | None = None
+        # Producer backpressure accounting: wall seconds the producer
+        # spent blocked on a FULL queue (the consumer wasn't ready).
+        # The fan-out pool (io/fanout.py) reads this per stream to
+        # separate a slow reader (straggler) from a saturated consumer.
+        self._stats_lock = threading.Lock()
+        self._stall_seconds = 0.0
         if depth <= 0:
             return
         self._q: queue.Queue = queue.Queue(maxsize=depth)
@@ -488,19 +495,39 @@ class _PrefetchIter:
 
     def _put_or_abort(self, item) -> bool:
         flight = self._obs.flight
-        while not self._stop.is_set():
-            try:
-                self._q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                # XF009 heartbeat: the producer is alive but blocked on
-                # a full queue — a 'backpressure' beat lets the
-                # watchdog tell a wedged CONSUMER (loader beating, no
-                # consumption) from a dead input pipeline (no beats)
-                if flight is not None:
-                    flight.note_loader("backpressure")
-                continue
-        return False
+        t0 = time.perf_counter()
+        try:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    # XF009 heartbeat: the producer is alive but
+                    # blocked on a full queue — a 'backpressure' beat
+                    # lets the watchdog tell a wedged CONSUMER (loader
+                    # beating, no consumption) from a dead input
+                    # pipeline (no beats)
+                    if flight is not None:
+                        flight.note_loader("backpressure")
+                    continue
+            return False
+        finally:
+            # anything past the free-slot fast path (microseconds) was
+            # the producer waiting on the consumer; the 1ms floor keeps
+            # per-batch noise out of the stall ledger
+            dt = time.perf_counter() - t0
+            if dt > 1e-3:
+                self._note_stall(dt)
+
+    def _note_stall(self, dt: float) -> None:
+        with self._stats_lock:
+            self._stall_seconds += dt
+
+    def stall_seconds(self) -> float:
+        """Cumulative producer-side backpressure (blocked-on-full-queue)
+        wall seconds so far.  Safe from any thread."""
+        with self._stats_lock:
+            return self._stall_seconds
 
     def _produce(self) -> None:
         try:

@@ -452,6 +452,84 @@ def shard_example_count(path: str) -> int:
         return int(meta["examples"])
 
 
+def split_shard_v2(
+    src: str, dst_prefix: str, num_shards: int
+) -> list[str]:
+    """Split one packed-v2 shard into up to ``num_shards`` contiguous
+    sub-shards ``<dst_prefix>-%05d`` — the corpus shape the input
+    fan-out (io/fanout.py) distributes across reader streams.
+
+    Records are self-contained (each carries its counts header and its
+    planes), so the split is a raw byte copy over the validated record
+    walk: no decode, no re-encode, and the concatenation of the
+    sub-shards' record streams is byte-identical to the source's.  Each
+    sub-shard gets the source header with its own batches/examples
+    totals; writers use the shared tail-safe tmp+fsync+os.replace
+    protocol.  Returns the written paths (fewer than ``num_shards``
+    when the source has fewer records)."""
+    import mmap
+
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    with open(src, "rb") as f:
+        meta, data_start = read_header(f)
+        if meta.get("version", 1) != 2:
+            raise ValueError("split_shard_v2 requires a v2 packed shard")
+        try:
+            # O(record) resident memory at any shard size (the same
+            # mmap discipline as the readers); only unmmapable streams
+            # pay a full buffer
+            blob: mmap.mmap | bytes = mmap.mmap(
+                f.fileno(), 0, access=mmap.ACCESS_READ
+            )
+        except (ValueError, OSError):
+            f.seek(0)
+            blob = f.read()
+        # record spans via the same walk _iter_records_v2 validates
+        spans: list[tuple[int, int, int]] = []  # (offset, next, n_real)
+        offset = data_start
+        end = len(blob)
+        while offset < end:
+            if offset + _REC_HEADER.size > end:
+                raise ValueError("truncated packed shard record")
+            fields = _REC_HEADER.unpack_from(blob, offset)
+            n_real, rec_bytes = fields[0], fields[7]
+            if rec_bytes <= 0 or offset + rec_bytes > end:
+                raise ValueError("truncated packed shard record")
+            spans.append((offset, offset + rec_bytes, n_real))
+            offset += rec_bytes
+        n_out = max(1, min(num_shards, len(spans)))
+        per = -(-len(spans) // n_out) if spans else 0
+        paths = []
+        for i in range(n_out):
+            chunk = spans[i * per: (i + 1) * per]
+            if not chunk:
+                break
+            dst = f"{dst_prefix}-{i:05d}"
+            tmp = f"{dst}.tmp.{os.getpid()}"
+            header = dict(meta)
+            try:
+                with open(tmp, "wb") as out:
+                    hdr_len = container.write_placeholder_header(
+                        out, MAGIC, header, ("batches", "examples")
+                    )
+                    for lo, hi, _ in chunk:
+                        out.write(blob[lo:hi])
+                    header.update({
+                        "batches": len(chunk),
+                        "examples": int(sum(r for _, _, r in chunk)),
+                    })
+                    container.rewrite_header(out, MAGIC, header, hdr_len)
+                    out.flush()
+                    os.fsync(out.fileno())
+                os.replace(tmp, dst)
+            finally:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+            paths.append(dst)
+    return paths
+
+
 def convert_shard(
     src: str,
     dst: str,

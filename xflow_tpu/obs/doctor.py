@@ -17,6 +17,8 @@ diagnosis instead of raw JSONL:
 * phase accounting → the dominant wall-clock phase, with an
   input-bound callout when stalls dominate;
 * per-rank step-time skew → straggler host callout (merged streams);
+* per-stream input fan-out skew (``stream`` rows, io/fanout.py) →
+  stream-straggler callout, same 1.3x rule on active throughput;
 * step-time shape → bimodality (p99 ≫ p50 while p90 stays near p50)
   as recompile suspicion;
 * serving tier → shed-storm windows (``serve_shed`` rows where
@@ -305,6 +307,53 @@ def _check_stragglers(rows: list[dict]) -> list[Diagnosis]:
         f"median ({1e3 * median:.2f}ms) across {len(means)} ranks — "
         "every synced step waits for it (slow host, shard skew, or "
         "thermal throttling)",
+    )]
+
+
+def _check_streams(rows: list[dict]) -> list[Diagnosis]:
+    """Input fan-out stream skew (``stream`` rows, io/fanout.py) —
+    the per-rank straggler rule applied to reader streams: a stream
+    whose ACTIVE throughput (examples over its measured
+    read+parse+compact seconds — a stream parked behind a saturated
+    consumer is not slow) lags the stream median by the straggler
+    ratio holds the whole serial-order merge back, because every
+    later shard it owns gates the consumer."""
+    per_stream: dict[int, list[float]] = {}
+    for r in rows:
+        if r.get("kind") != "stream":
+            continue
+        eps = float(r.get("examples_per_sec", 0.0))
+        if eps > 0:
+            per_stream.setdefault(int(r.get("stream", 0)), []).append(eps)
+    if len(per_stream) < 2:
+        return []
+    means = {s: sum(v) / len(v) for s, v in per_stream.items()}
+    # upper-middle median: the candidate straggler (SLOWEST stream)
+    # must compare against the faster half, mirroring _check_stragglers
+    ordered = sorted(means.values())
+    median = ordered[len(ordered) // 2]
+    worst_stream, worst = min(means.items(), key=lambda kv: kv[1])
+    if worst <= 0 or median <= 0:
+        return []
+    ratio = median / worst
+    if ratio < STRAGGLER_RATIO:
+        return [Diagnosis(
+            "info",
+            "stream_skew",
+            f"input-stream throughput skew across {len(means)} streams "
+            f"is {ratio:.2f}x (median/min) — within the "
+            f"{STRAGGLER_RATIO}x straggler threshold",
+        )]
+    return [Diagnosis(
+        "warn",
+        "stream_straggler",
+        f"input-stream straggler: stream {worst_stream} mean "
+        f"{worst:.0f} ex/s is {ratio:.2f}x slower than the stream "
+        f"median ({median:.0f} ex/s) across {len(means)} streams — "
+        "the serial-order merge waits on every shard it owns (shard "
+        "size skew, a slow disk, or parse contention; stall_seconds "
+        "in its stream rows says whether it was actually consumer-"
+        "bound)",
     )]
 
 
@@ -782,6 +831,7 @@ def diagnose(
         findings.extend(_check_flight(flight))
     findings.extend(_check_phases(rows))
     findings.extend(_check_stragglers(rows))
+    findings.extend(_check_streams(rows))
     findings.extend(_check_bimodality(rows))
     findings.extend(_check_store(rows))
     if bench is not None:

@@ -84,6 +84,29 @@ SCHEMA: dict[str, dict[str, Any]] = {
         "seconds": (int, float),
         "examples_per_sec": (int, float),
     },
+    # one per reader stream per epoch under the input fan-out
+    # (Config.input_streams > 1; io/fanout.py, docs/PERF.md "Input
+    # fan-out"): finished-shard totals, producer wall seconds, and
+    # backpressure stall seconds (producer blocked on a full queue —
+    # the consumer's fault, not the stream's).  read_seconds is the
+    # directly measured read+parse+compact time (queue waits
+    # excluded); examples_per_sec = examples / read_seconds, so `obs
+    # doctor` can rank a genuinely slow stream (shard skew, slow
+    # disk) as a straggler without blaming a stream parked behind a
+    # saturated device.
+    "stream": {
+        "t": (int, float),
+        "kind": str,
+        "epoch": int,
+        "stream": int,
+        "shards": int,
+        "batches": int,
+        "examples": int,
+        "seconds": (int, float),
+        "read_seconds": (int, float),
+        "stall_seconds": (int, float),
+        "examples_per_sec": (int, float),
+    },
     # one per epoch: jax.local_devices() memory stats
     "device_mem": {
         "t": (int, float),

@@ -198,6 +198,29 @@ def format_run(run: Run) -> str:
             f"min/mean/max = {min(rates):.0f}/"
             f"{sum(rates) / len(rates):.0f}/{max(rates):.0f} ex/s"
         )
+    streams = run.kind("stream")
+    if streams:
+        # per-stream mean across epochs, so one cold epoch doesn't
+        # read as a straggling stream; zero-rate rows (a stream that
+        # never finished a shard — preempted epoch) are excluded like
+        # doctor._check_streams does, instead of exploding the ratio
+        per: dict[int, list[float]] = {}
+        stall = 0.0
+        for s in streams:
+            eps = float(s.get("examples_per_sec", 0.0))
+            if eps > 0:
+                per.setdefault(int(s.get("stream", 0)), []).append(eps)
+            stall += float(s.get("stall_seconds", 0.0))
+        if per:
+            means = [sum(v) / len(v) for v in per.values()]
+            lo, hi = min(means), max(means)
+            out.append(
+                f"input streams: {len(per)} (fan-out, io/fanout.py), "
+                f"throughput min/mean/max = {lo:.0f}/"
+                f"{sum(means) / len(means):.0f}/{hi:.0f} ex/s, "
+                f"spread max/min = {hi / lo:.2f}x, "
+                f"backpressure stall {stall:.1f}s total"
+            )
     mem = run.kind("device_mem")
     if mem:
         last = mem[-1].get("devices") or []

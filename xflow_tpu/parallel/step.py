@@ -452,6 +452,34 @@ class TrainStep:
         kh = cfg.hot_nnz if cfg.hot_size else 0
         return batch.max_nnz == cfg.max_nnz and batch.hot_nnz == kh
 
+    def precompact(self, batch):
+        """Host dictionary compaction off the consumer thread: the
+        CompactBatch ``put_batch`` would otherwise build inline, or the
+        batch unchanged when the dict wire (or this batch's geometry)
+        doesn't apply.  The input fan-out's stream workers
+        (io/fanout.py) run this per batch so compaction parallelizes
+        across N streams instead of serializing on the staging ring —
+        put_batch on the result is a plane collection plus the h2d
+        transfer.  Deterministic: the compacted planes are exactly the
+        inline path's, so fan-out training stays bitwise-identical."""
+        from xflow_tpu.io.compact import CompactBatch
+
+        if (
+            isinstance(batch, CompactBatch)
+            or not self.dict_wire
+            or self.store is not None
+            or not self._dict_geometry_ok(batch)
+        ):
+            return batch
+        cb = CompactBatch.from_batch(
+            batch, self.cfg.table_size, self.cfg.hot_size,
+            # the put_batch latch: racing streams at worst BOTH validate
+            # their first batch — extra checking (xf: ignore[XF008])
+            check=not self._compact_validated,
+        )
+        self._compact_validated = True  # same latch; xf: ignore[XF008]
+        return cb
+
     def host_wire_np(self, batch, check: bool = False):
         """The host half of put_batch: the numpy planes that cross the
         link for ``batch`` under this step's wire format, plus the

@@ -143,6 +143,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="apply promotion/demotion plans every N train steps",
     )
     p.add_argument(
+        "--input-streams", type=int, dest="input_streams",
+        help="parallel sharded input fan-out (io/fanout.py): N "
+        "concurrent shard-reader streams, each with its own read -> "
+        "parse -> compact worker; batch order stays the serial shard "
+        "order, so training is bitwise-identical to 1 (the default, "
+        "serial reader) — docs/PERF.md \"Input fan-out\"",
+    )
+    p.add_argument(
+        "--transfer-ahead-depth", type=int, dest="transfer_ahead_depth",
+        help="device staging ring depth: batches staged ahead on "
+        "worker threads (put_batch overlap; >= 2 = double buffering, "
+        "deeper absorbs link jitter)",
+    )
+    p.add_argument(
         "--wire-mode", choices=["auto", "full", "compact"], dest="wire_mode",
         help="host->device batch format; compact ships ~16x fewer "
         "bytes/entry (hash mode; slot-reading models add a u8 slots "

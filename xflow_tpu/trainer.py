@@ -43,6 +43,16 @@ from xflow_tpu.utils.checkpoint import (
 from xflow_tpu.utils.metrics import AucAccumulator
 
 
+def _ring_workers(depth: int) -> int:
+    """Staging-ring worker count for a given depth: one per slot up to
+    a core-bounded cap (at least 2 once the ring is deep enough for
+    double buffering — compaction on one worker must be able to overlap
+    a transfer on another)."""
+    if depth <= 1:
+        return 1
+    return min(depth, max(2, min(4, (os.cpu_count() or 2) - 1)))
+
+
 def find_shards(prefix: str) -> list[str]:
     """All existing ``prefix-%05d`` shards, in rank order; if none match,
     treat ``prefix`` itself as a single file."""
@@ -488,11 +498,18 @@ class Trainer:
     ) -> Iterator[tuple[Batch, int, int]]:
         """Yields (batch, shard_index, resume_offset) over one epoch.
 
+        With ``Config.input_streams > 1`` the epoch's shard list fans
+        out over N concurrent reader streams (io/fanout.py) — same
+        batch sequence, same resume contract, parallel host work.
+
         When metrics are on, each finished shard logs a ``shard`` row
         with its observed loader throughput — wall-clock measured at
         the consumer, so it includes parse + pack + any consumer
         backpressure: the rate the training loop actually saw."""
         shards = self._my_shards(self.cfg.train_path)
+        if self.cfg.input_streams > 1:
+            yield from self._iter_fanout(shards, start_shard, start_offset)
+            return
         depth = self.cfg.prefetch_batches
         for si, path in enumerate(shards):
             if si < start_shard:
@@ -516,16 +533,92 @@ class Trainer:
                 if depth:
                     it.close()
                     self._live_prefetch.discard(it)
-            dt = time.perf_counter() - t_shard
-            if self.metrics_logger is not None:
-                self.metrics_logger.log("shard", {
-                    "epoch": self.epoch,
-                    "shard": os.path.basename(path),
-                    "index": si,
-                    "examples": examples,
-                    "seconds": round(dt, 3),
-                    "examples_per_sec": round(examples / max(dt, 1e-9), 1),
-                })
+            self._log_shard_row(
+                si, path, examples, time.perf_counter() - t_shard
+            )
+
+    def _log_shard_row(
+        self, si: int, path: str, examples: int, dt: float
+    ) -> None:
+        if self.metrics_logger is None:
+            return
+        self.metrics_logger.log("shard", {
+            "epoch": self.epoch,
+            "shard": os.path.basename(path),
+            "index": si,
+            "examples": examples,
+            "seconds": round(dt, 3),
+            "examples_per_sec": round(examples / max(dt, 1e-9), 1),
+        })
+
+    def _log_stream_rows(self, pool) -> None:
+        """Per-stream fan-out accounting (``stream`` rows,
+        obs/schema.py): one row per reader stream per epoch with its
+        finished-shard totals and backpressure stall — the input of
+        `obs doctor`'s stream-straggler diagnosis and `obs summarize`'s
+        throughput-spread line."""
+        if self.metrics_logger is None:
+            return
+        for row in pool.stream_stats():
+            self.metrics_logger.log("stream", {"epoch": self.epoch, **row})
+
+    def _iter_fanout(
+        self, shards: list[str], start_shard: int, start_offset: int
+    ) -> Iterator[tuple[Batch, int, int]]:
+        """iter_train_batches through the N-stream fan-out
+        (io/fanout.py): stream s reads shards i % N == s concurrently,
+        each with its own parse workers and host compaction
+        (TrainStep.precompact), and the merge restores serial shard
+        order — training is bitwise-identical to the one-stream path.
+        Per-shard ``shard`` rows keep the serial path's consumer-side
+        timing semantics; per-stream ``stream`` rows land when the
+        epoch's pool winds down (including the preemption break)."""
+        from xflow_tpu.io.fanout import ShardStreamPool
+
+        cfg = self.cfg
+        workers = self._parse_workers()
+        n_eff = max(1, min(cfg.input_streams, len(shards) - start_shard))
+        pool = ShardStreamPool(
+            shards,
+            self._loader,
+            num_streams=cfg.input_streams,
+            depth=max(1, cfg.prefetch_batches),
+            start_shard=start_shard,
+            start_offset=start_offset,
+            # the serial path's parse fan-out divides across streams so
+            # N streams don't multiply the thread budget
+            parse_workers=max(1, workers // n_eff) if workers > 1 else workers,
+            transform=self.step.precompact,
+            obs=self.obs,
+        )
+        self._live_prefetch.add(pool)
+        cur: int | None = None
+        examples = 0
+        t_shard = time.perf_counter()
+        try:
+            for batch, si, resume in pool:
+                if cur is None:
+                    cur = si
+                elif si != cur:
+                    self._log_shard_row(
+                        cur, shards[cur], examples,
+                        time.perf_counter() - t_shard,
+                    )
+                    cur = si
+                    examples = 0
+                    t_shard = time.perf_counter()
+                examples += batch.num_real()
+                self._note_batch_shape(batch, si)
+                yield batch, si, resume
+            if cur is not None:
+                self._log_shard_row(
+                    cur, shards[cur], examples,
+                    time.perf_counter() - t_shard,
+                )
+        finally:
+            pool.close()
+            self._live_prefetch.discard(pool)
+            self._log_stream_rows(pool)
 
     def _empty_batch(self) -> Batch:
         """All-padding batch (weights/mask 0): a no-op training step with
@@ -606,19 +699,22 @@ class Trainer:
         self, it: Iterator[tuple[Batch, int, int]], depth: int | None = None
     ) -> Iterator[tuple[Any, int, int]]:
         """Device staging ring: run put_batch (host-side compaction +
-        h2d transfer) up to ``depth`` (Config.transfer_ahead, >= 2 for
-        double buffering) items ahead on worker threads so link
-        round-trips AND per-batch compaction overlap device compute —
-        measured 2-3x e2e on the tunneled link (docs/PERF.md).  Two
-        workers when the ring is deep enough, so one batch can compact
-        while another is on the wire.  Single-host only: multi-host
-        put_batch is collective (host_local_array_to_global_array) and
-        must stay on the voting thread."""
+        h2d transfer) up to ``depth`` (Config.transfer_ahead_depth,
+        >= 2 for double buffering) items ahead on worker threads so
+        link round-trips AND per-batch compaction overlap device
+        compute — measured 2-3x e2e on the tunneled link
+        (docs/PERF.md).  Worker count scales with the ring depth
+        (capped by the host's cores) so a deep ring can compact one
+        batch while others are on the wire; the pending deque preserves
+        submission order, so batch order — and training — is identical
+        at ANY depth.  Single-host only: multi-host put_batch is
+        collective (host_local_array_to_global_array) and must stay on
+        the voting thread."""
         from concurrent.futures import ThreadPoolExecutor
 
         if depth is None:
-            depth = self.cfg.transfer_ahead
-        ex = ThreadPoolExecutor(min(2, depth))
+            depth = self.cfg.transfer_ahead_depth
+        ex = ThreadPoolExecutor(_ring_workers(depth))
         try:
             pending: deque = deque()
             for batch, si, resume in it:
