@@ -63,15 +63,26 @@ def main(argv: list[str] | None = None) -> int:
 
     # one read per artifact: every later filter/lookup goes through
     # this memo (an artifact rewritten mid-run can't be seen in two
-    # different states)
-    results = {
-        p_: load_bench_result(p_) for p_ in find_bench_artifacts(args.root)
-    }
+    # different states).  load_bench_result only swallows parse
+    # errors; an artifact that can't be READ (racing delete, bad
+    # perms) must degrade to "not usable", not crash the gate.
+    results: dict[str, dict | None] = {}
+    unreadable = []
+    for p_ in find_bench_artifacts(args.root):
+        try:
+            results[p_] = load_bench_result(p_)
+        except OSError as e:
+            results[p_] = None
+            unreadable.append(f"{p_} ({e.strerror or e})")
     usable = [p_ for p_, r in results.items() if r is not None]
     if len(usable) < 2:
+        detail = (
+            "; unreadable: " + ", ".join(unreadable) if unreadable else ""
+        )
         print(
             f"SKIP: {len(usable)} usable bench artifact(s) under "
             f"{args.root} — need a latest and at least one prior"
+            f"{detail}"
         )
         return 1 if args.strict else 0
     latest = usable[-1]

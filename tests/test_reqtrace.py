@@ -1,0 +1,469 @@
+"""Request-scoped tracing (ISSUE 16): context wire formats, head+tail
+sampling, chain-filled phase decomposition, span-tree completeness
+under concurrent mixed traffic, batch/digest integrity across a hot
+swap, the flight-recorder heartbeat's oldest-trace detail, and the
+tier-1 smoke gate (scripts/check_reqtrace_smoke.py)."""
+
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from xflow_tpu.config import Config
+from xflow_tpu.obs.reqtrace import (
+    PHASES,
+    ReqTraceSink,
+    TraceContext,
+    format_header,
+    head_keep,
+    parse_header,
+)
+
+repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- context wire formats ----------------------------------------------------
+
+
+def test_header_roundtrip():
+    ctx = TraceContext(0xDEADBEEF12345678, 0x42, True)
+    back = parse_header(format_header(ctx))
+    assert back is not None
+    assert back.trace_id == ctx.trace_id
+    assert back.parent_span_id == ctx.parent_span_id
+    assert back.sampled is True
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "nope", "12-34", "xyz-0-1", "12-34-5", "12-34-1-extra",
+    "0000000000000000-0000000000000000-1",  # trace id 0 is reserved
+])
+def test_header_malformed_is_absent(bad):
+    assert parse_header(bad) is None
+
+
+def test_packed_wire_roundtrip_traced_and_plain():
+    from xflow_tpu.serve.server import (
+        decode_packed_request,
+        decode_packed_request_traced,
+        encode_packed_request,
+    )
+
+    row = (
+        np.array([3, 5, 9], np.int64),
+        np.array([0, 1, 2], np.int32),
+        np.array([1.0, 1.0, 0.5], np.float32),
+    )
+    ctx = TraceContext(0x1122334455667788, 0x99, True)
+    buf = encode_packed_request([row], trace=ctx)
+    rows, back = decode_packed_request_traced(buf)
+    assert back is not None and back.trace_id == ctx.trace_id
+    assert back.parent_span_id == 0x99 and back.sampled is True
+    np.testing.assert_array_equal(rows[0][0], row[0])
+    np.testing.assert_array_equal(rows[0][1], row[1])
+    # untraced XFS1 stays the pre-tracing format, trace is None
+    plain = encode_packed_request([row])
+    rows2, none = decode_packed_request_traced(plain)
+    assert none is None
+    np.testing.assert_array_equal(rows2[0][0], row[0])
+    # the legacy single-return decoder still answers rows
+    np.testing.assert_array_equal(decode_packed_request(buf)[0][0], row[0])
+
+
+def test_packed_wire_rejects_bad_trace_triple():
+    from xflow_tpu.serve.server import (
+        PACKED_TRACE_MAGIC,
+        decode_packed_request_traced,
+        encode_packed_request,
+    )
+
+    with pytest.raises(ValueError, match="trace triple"):
+        decode_packed_request_traced(PACKED_TRACE_MAGIC + b"\x00" * 8)
+    buf = bytearray(encode_packed_request(
+        [(np.array([1], np.int64), None, None)],
+        trace=TraceContext(7),
+    ))
+    buf[4:12] = b"\x00" * 8  # trace id 0
+    with pytest.raises(ValueError, match="trace triple"):
+        decode_packed_request_traced(bytes(buf))
+
+
+# -- sampling ----------------------------------------------------------------
+
+
+def test_head_keep_deterministic_and_bounded():
+    assert not head_keep(123, 0.0)
+    assert head_keep(123, 1.0)
+    verdicts = [head_keep(i, 0.5) for i in range(2000)]
+    assert verdicts == [head_keep(i, 0.5) for i in range(2000)]
+    frac = sum(verdicts) / len(verdicts)
+    assert 0.4 < frac < 0.6  # splitmix64 is uniform enough at n=2000
+
+
+def test_config_sample_validation():
+    assert Config(obs_reqtrace_sample=0.0).obs_reqtrace_sample == 0.0
+    with pytest.raises(ValueError, match="obs_reqtrace_sample"):
+        Config(obs_reqtrace_sample=1.5)
+    with pytest.raises(ValueError, match="obs_reqtrace_sample"):
+        Config(obs_reqtrace_sample=-0.1)
+
+
+# -- span records ------------------------------------------------------------
+
+
+def test_complete_chain_fills_missing_stamps():
+    sink = ReqTraceSink(sample=1.0)
+    span = sink.start(None, "score")
+    sink.complete(span, "shed", detail="queue_depth")
+    [rec] = sink.flush()
+    assert rec["status"] == "shed" and rec["keep"] == "shed"
+    assert sorted(rec["phases"]) == sorted(PHASES)
+    # nothing past arrival was reached: the whole life books as
+    # admission_wait and the phase sum IS the e2e
+    assert rec["phases"]["admission_wait"] == pytest.approx(
+        rec["e2e"], abs=1e-9
+    )
+    assert sum(rec["phases"].values()) == pytest.approx(
+        rec["e2e"], abs=1e-5
+    )
+
+
+def test_complete_full_stamps_partition_e2e():
+    sink = ReqTraceSink(sample=1.0)
+    span = sink.start(None, "score")
+    t = time.perf_counter() - 0.050  # a request that arrived 50ms ago
+    span.t_arrival = t
+    span.t_enq = t + 0.010
+    span.t_seal = t + 0.030
+    span.t_deq = t + 0.031
+    span.t_feat = t + 0.041
+    sink.complete(span)
+    [rec] = sink.flush()
+    ph = rec["phases"]
+    assert ph["admission_wait"] == pytest.approx(0.010, abs=1e-6)
+    assert ph["coalesce_wait"] == pytest.approx(0.020, abs=1e-6)
+    assert ph["swap_stall"] == pytest.approx(0.001, abs=1e-6)
+    assert ph["featurize"] == pytest.approx(0.010, abs=1e-6)
+    assert ph["device"] > 0.0  # t_feat -> completion, wall clock
+    assert sum(ph.values()) == pytest.approx(rec["e2e"], abs=1e-5)
+
+
+def _finished_span(sink, e2e_s, status="ok", stage="score", trace=None):
+    span = sink.start(trace, stage)
+    span.t_arrival = time.perf_counter() - e2e_s
+    span.t_enq = span.t_seal = span.t_deq = span.t_feat = None
+    sink.complete(span, status)
+    return span
+
+
+def test_flush_keeps_errors_sheds_and_slowest_at_sample_zero():
+    sink = ReqTraceSink(sample=0.0, slow_k=2)
+    for i in range(20):
+        _finished_span(sink, 0.001 * (i + 1))
+    _finished_span(sink, 0.0001, status="error")
+    _finished_span(sink, 0.0001, status="shed")
+    rows = sink.flush()
+    keeps = sorted(r["keep"] for r in rows)
+    assert keeps.count("slow") == 2
+    assert keeps.count("error") == 1
+    assert keeps.count("shed") == 1
+    assert "head" not in keeps
+    # the slow exemplars really are the window's slowest
+    slow_e2e = sorted(
+        r["e2e"] for r in rows if r["keep"] == "slow"
+    )
+    assert slow_e2e[0] >= 0.019
+
+
+def test_flush_head_keeps_everything_at_sample_one():
+    sink = ReqTraceSink(sample=1.0, slow_k=1)
+    for i in range(10):
+        _finished_span(sink, 0.001 * (i + 1))
+    rows = sink.flush()
+    assert len(rows) == 10
+    assert all(r["keep"] in ("head", "slow") for r in rows)
+
+
+def test_flush_promotes_whole_trace_trees():
+    sink = ReqTraceSink(sample=0.0, slow_k=1)
+    # one trace with two spans (a cascade: retrieval + ranking); only
+    # the ranking span is slow enough to be a tail exemplar
+    ctx = sink.mint()
+    _finished_span(sink, 0.0001, stage="retrieval", trace=ctx)
+    _finished_span(sink, 0.5, stage="ranking", trace=ctx)
+    for _ in range(5):
+        _finished_span(sink, 0.001)
+    rows = sink.flush()
+    mine = [r for r in rows if r["trace_id"] == f"{ctx.trace_id:016x}"]
+    assert len(mine) == 2  # the fast sibling rode along...
+    assert {r["keep"] for r in mine} == {"slow", "tree"}
+    others = [r for r in rows if r["trace_id"] != f"{ctx.trace_id:016x}"]
+    assert not others  # ...and unsampled fast singletons did not
+
+
+def test_flush_keeps_only_referenced_batches():
+    sink = ReqTraceSink(sample=0.0, slow_k=1)
+    span = sink.start(None, "score")
+    span.batch_id = sink.next_batch_id()
+    sink.note_batch(span.batch_id, [span.trace_id], "digest-a", 8,
+                    {"device": 0.001})
+    sink.complete(span)
+    orphan = sink.next_batch_id()
+    sink.note_batch(orphan, [12345], "digest-a", 8, {"device": 0.001})
+    rows = sink.flush()
+    batches = [r for r in rows if r["span"] == "batch"]
+    assert len(batches) == 1
+    assert batches[0]["batch"] == f"b{span.batch_id}"
+    assert batches[0]["keep"] == "batch"
+
+
+def test_sink_capacity_drops_are_counted():
+    sink = ReqTraceSink(sample=1.0, capacity=2)
+    for _ in range(5):
+        _finished_span(sink, 0.001)
+    assert sink.dropped == 3
+    assert len(sink.flush()) == 2
+
+
+# -- live fleets: propagation under concurrency ------------------------------
+
+
+def _live_engine(model_name, **over):
+    from xflow_tpu.models import make_model
+    from xflow_tpu.optim import make_optimizer
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.parallel.step import init_state
+    from xflow_tpu.serve.engine import PredictEngine
+
+    base = dict(
+        model=model_name,
+        table_size_log2=10,
+        batch_size=8,
+        max_nnz=8,
+        max_fields=8,
+        tower_split_field=4,
+        tower_dim=4,
+        num_devices=1,
+    )
+    base.update(over)
+    cfg = Config(**base)
+    mesh = make_mesh(1)
+    model = make_model(cfg)
+    state = init_state(model, make_optimizer(cfg), cfg, mesh)
+    return PredictEngine(cfg, state, mesh=mesh, buckets=(4, 8))
+
+
+def _item_index(n=6, dim=6, nnz=3, table_size=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "count": n,
+        "dim": dim,
+        "item_index": rng.normal(size=(n, dim)).astype(np.float32),
+        "item_ids": (10 + np.arange(n)).astype(np.int64),
+        "item_keys": rng.integers(0, table_size, (n, nnz)).astype(np.int64),
+        "item_slots": np.full((n, nnz), 5, np.int32),
+        "item_vals": np.ones((n, nnz), np.float32),
+        "item_nnz": np.full(n, nnz, np.int32),
+    }
+
+
+def _user_row(rng):
+    return (
+        rng.integers(0, 1024, 3).astype(np.int64),
+        rng.integers(0, 4, 3).astype(np.int32),
+        None,
+    )
+
+
+def test_concurrent_mixed_traffic_builds_complete_trees():
+    """N threads of mixed single-row / top-k / cascade traffic: every
+    response's trace id maps to exactly one complete span tree — one
+    span for the flat kinds, 1 retrieval + k ranking spans for a
+    cascade — and every ok span's batch reference resolves to a batch
+    span that fans the trace id in with ONE digest."""
+    from xflow_tpu.serve.cascade import CascadeEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+
+    sink = ReqTraceSink(sample=1.0)
+    retr_eng = _live_engine("two_tower")
+    retr_eng.attach_item_index(_item_index(), topk_k=4)
+    retrieval = ReplicaFleet(
+        retr_eng, replicas=2, topk=True, deadline_budget_ms=5000.0,
+        depth_budget=1024, reqtrace=sink,
+    )
+    retrieval.reqtrace_stage = "retrieval"
+    ranking = ReplicaFleet(
+        _live_engine("dcn"), replicas=2, deadline_budget_ms=5000.0,
+        depth_budget=1024, reqtrace=sink,
+    )
+    ranking.reqtrace_stage = "ranking"
+    K = 3
+    cascade = CascadeEngine(retrieval, ranking, k=K)
+    lock = threading.Lock()
+    issued: list[tuple[str, str]] = []  # (kind, trace_id hex)
+    fails: list[str] = []
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for i in range(6):
+                ctx = sink.mint()
+                tid = f"{ctx.trace_id:016x}"
+                row = _user_row(rng)
+                kind = ("score", "topk", "cascade")[i % 3]
+                if kind == "score":
+                    ranking.submit(*row, trace=ctx).result(timeout=60)
+                elif kind == "topk":
+                    retrieval.submit(*row, trace=ctx).result(timeout=60)
+                else:
+                    out = cascade.recommend(*row, trace=ctx)
+                    assert len(out["items"]) == K
+                with lock:
+                    issued.append((kind, tid))
+        except Exception as e:  # noqa: BLE001 - collected for the assert
+            with lock:
+                fails.append(f"worker {seed}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not fails, fails
+    rows = sink.flush()
+    reqs = [r for r in rows if r["span"] == "request"]
+    batches = {r["batch"]: r for r in rows if r["span"] == "batch"}
+    by_trace: dict[str, list[dict]] = {}
+    for r in reqs:
+        by_trace.setdefault(r["trace_id"], []).append(r)
+    for kind, tid in issued:
+        tree = by_trace.get(tid)
+        assert tree, f"{kind} trace {tid} emitted no spans"
+        stages = sorted(s["stage"] for s in tree)
+        if kind == "score":
+            assert stages == ["ranking"], (tid, stages)
+        elif kind == "topk":
+            assert stages == ["retrieval"], (tid, stages)
+        else:
+            assert stages == ["ranking"] * K + ["retrieval"], (tid, stages)
+        for s in tree:
+            assert s["status"] == "ok"
+            assert sorted(s["phases"]) == sorted(PHASES)
+            assert sum(s["phases"].values()) == pytest.approx(
+                s["e2e"], abs=1e-4
+            )
+            b = batches[s["batch"]]  # ok spans always reference one
+            assert tid in b["trace_ids"]
+            assert s["digest"] == b["digest"]
+    # exactly one tree per issued trace — ids never bleed across kinds
+    assert len(issued) == len({tid for _, tid in issued})
+    retrieval.close()
+    ranking.close()
+
+
+def test_batch_spans_never_mix_digests_across_swap():
+    """Under a forced hot swap with traffic in flight, every batch
+    span carries ONE digest and every member request span agrees with
+    its batch — a batch can never straddle a rollout swap."""
+    from xflow_tpu.serve.fleet import ReplicaFleet
+
+    sink = ReqTraceSink(sample=1.0)
+    fleet = ReplicaFleet(
+        _live_engine("lr"), replicas=1, deadline_budget_ms=5000.0,
+        depth_budget=1024, reqtrace=sink,
+    )
+    other = _live_engine("lr", batch_size=16)  # different config digest
+    assert other.digest != fleet.digest
+    rng = np.random.default_rng(1)
+    fails: list[str] = []
+
+    def pound(seed):
+        r = np.random.default_rng(seed)
+        try:
+            for _ in range(10):
+                fleet.submit(*_user_row(r)).result(timeout=60)
+        except Exception as e:  # noqa: BLE001
+            fails.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=pound, args=(s,)) for s in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)
+    fleet.batchers[0].swap(other, force=True)
+    for t in threads:
+        t.join(timeout=120)
+    assert not fails, fails
+    fleet.submit(*_user_row(rng)).result(timeout=60)  # lands post-swap
+    rows = sink.flush()
+    batches = {r["batch"]: r for r in rows if r["span"] == "batch"}
+    assert batches
+    digests = set()
+    for r in rows:
+        if r["span"] != "request":
+            continue
+        b = batches[r["batch"]]
+        assert r["digest"] == b["digest"], (r["trace_id"], r["digest"])
+        digests.add(r["digest"])
+    assert other.digest in digests  # the post-swap request scored there
+    fleet.close()
+
+
+def test_heartbeat_names_oldest_queued_trace():
+    """The batcher's flight heartbeat carries the oldest in-flight
+    trace id while a backlog exists — the detail the watchdog copies
+    into serve_queue_stall health rows."""
+    from xflow_tpu.serve.batcher import MicroBatcher
+
+    class FlightSpy:
+        def __init__(self):
+            self.details = []
+
+        def note_serve(self, detail="batch"):
+            self.details.append(detail)
+
+    sink = ReqTraceSink(sample=1.0)
+    spy = FlightSpy()
+    eng = _live_engine("lr")
+    b = MicroBatcher(eng, max_wait_ms=0.0, max_batch=1, flight=spy)
+    rng = np.random.default_rng(2)
+    with b._swap_lock:  # stall the worker so a backlog builds
+        futs = [
+            b.submit(*_user_row(rng), trace=sink.start(None, "score"))
+            for _ in range(3)
+        ]
+        time.sleep(0.05)
+        assert b.oldest_trace() is not None
+    for f in futs:
+        f.result(timeout=60)
+    b.close()
+    traced = [d for d in spy.details
+              if re.fullmatch(r"batch oldest_trace=[0-9a-f]{16}", d)]
+    assert traced, spy.details
+    assert "batch" in spy.details  # and the backlog-free form too
+
+
+# -- tier-1 gate -------------------------------------------------------------
+
+
+def test_check_reqtrace_smoke_script():
+    """The CI lint (scripts/check_reqtrace_smoke.py) passes — run as a
+    subprocess exactly as CI would (tier-1 wiring, like
+    check_serve_smoke.py / check_cascade_smoke.py)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "scripts", "check_reqtrace_smoke.py")],
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=600,
+        cwd=repo,
+    )
+    assert proc.returncode == 0, (
+        f"check_reqtrace_smoke failed:\nstdout:\n{proc.stdout}\n"
+        f"stderr:\n{proc.stderr}"
+    )

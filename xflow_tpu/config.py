@@ -139,6 +139,13 @@ class Config:
     obs_watchdog_input_s: float = 30.0
     obs_watchdog_device_s: float = 120.0
     obs_watchdog_serve_s: float = 10.0
+    # Request-scoped tracing (obs/reqtrace.py, docs/OBSERVABILITY.md
+    # "Tracing a request"): head-sampling keep fraction in [0, 1] for
+    # healthy requests.  Errors, sheds, and the window's slowest-k
+    # exemplars are ALWAYS kept regardless of this rate; 0.01 keeps
+    # 1% of the rest.  The serve CLI's --reqtrace-sample attaches the
+    # sink; this is the default rate it samples at.
+    obs_reqtrace_sample: float = 0.01
     # Monitor poll interval (0 = auto: a quarter of the tightest
     # threshold, so a stall is classified within its threshold).
     obs_watchdog_poll_s: float = 0.0
@@ -592,6 +599,8 @@ class Config:
             )
         if self.obs_trace_capacity < 1:
             raise ValueError("obs_trace_capacity must be >= 1")
+        if not 0.0 <= self.obs_reqtrace_sample <= 1.0:
+            raise ValueError("obs_reqtrace_sample must be in [0, 1]")
         if self.obs_flight_events < 1:
             raise ValueError("obs_flight_events must be >= 1")
         if self.obs_watchdog:

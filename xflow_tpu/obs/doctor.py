@@ -82,6 +82,18 @@ STORE_THRASH_HIT_RATE = 0.5
 SHED_STORM_FRAC = 0.5
 SHED_STORM_MIN_TOTAL = 20
 
+# tail attribution over reqtrace request spans (obs/reqtrace.py): the
+# slowest-k exemplars must be BOTH a multiple of the all-request p50
+# and a hard absolute excess above it before the tail is called
+# anomalous — the ratio alone trips on millisecond scheduler noise in
+# toy runs, the floor alone trips on any genuinely slow tier.  The
+# row floor keeps a handful of warmup requests from electing a
+# dominant phase.
+REQTRACE_MIN_REQUESTS = 20
+REQTRACE_TAIL_RATIO = 3.0
+REQTRACE_TAIL_MIN_EXCESS_S = 0.05
+REQTRACE_SLOW_K = 3
+
 # health causes owned by the self-healing fabric (xflow_tpu/chaos/,
 # docs/ROBUSTNESS.md): routed to _check_chaos for a named diagnosis —
 # _check_health must NOT read them as watchdog stall trips (a
@@ -490,6 +502,114 @@ def _check_serve(
     return out
 
 
+def _median(vals: list[float]) -> float:
+    s = sorted(vals)
+    return s[len(s) // 2] if s else 0.0
+
+
+def _check_reqtrace(
+    rows: list[dict],
+    shed_storm: bool = False,
+    queue_stall: bool = False,
+) -> list[Diagnosis]:
+    """Tail-latency attribution from ``reqtrace`` request spans
+    (obs/reqtrace.py, docs/OBSERVABILITY.md).
+
+    * **reqtrace_tail** — the slowest-k requests' mean e2e sits far
+      above the all-request p50 AND one phase explains the excess:
+      per-phase, take the slow-k mean minus the all-request median
+      (clamped at zero) and name the argmax.  The dominant phase is
+      cross-checked against the capacity findings already made from
+      serve_shed/watchdog rows: a queue-side phase (admission_wait,
+      coalesce_wait) dominating alongside a shed storm or queue stall
+      is the same capacity condition seen from inside a request; a
+      device-dominated tail alongside those findings means the queue
+      symptoms are downstream of a slow device call, so fixing
+      admission or fleet size would treat the symptom.
+    * **reqtrace_tail_ok** (info) — enough traced requests and the
+      tail is within normal spread of the median: decomposition
+      reported, nothing to fix."""
+    reqs = [
+        r for r in rows
+        if r.get("kind") == "reqtrace" and r.get("span") == "request"
+        and isinstance(r.get("phases"), dict) and "e2e" in r
+    ]
+    if len(reqs) < REQTRACE_MIN_REQUESTS:
+        return []
+    e2es = [float(r["e2e"]) for r in reqs]
+    p50 = _median(e2es)
+    slow = sorted(reqs, key=lambda r: float(r["e2e"]), reverse=True)
+    slow = slow[:REQTRACE_SLOW_K]
+    slow_mean = sum(float(r["e2e"]) for r in slow) / len(slow)
+    phases = sorted({p for r in reqs for p in r["phases"]})
+    med = {
+        p: _median([float(r["phases"].get(p, 0.0)) for r in reqs])
+        for p in phases
+    }
+    excess = {
+        p: max(
+            0.0,
+            sum(float(r["phases"].get(p, 0.0)) for r in slow)
+            / len(slow) - med[p],
+        )
+        for p in phases
+    }
+    if (
+        slow_mean < REQTRACE_TAIL_RATIO * p50
+        or slow_mean - p50 < REQTRACE_TAIL_MIN_EXCESS_S
+        or not any(excess.values())
+    ):
+        return [Diagnosis(
+            "info", "reqtrace_tail_ok",
+            f"reqtrace: {len(reqs)} request span(s), p50 "
+            f"{1e3 * p50:.1f}ms, slowest-{len(slow)} mean "
+            f"{1e3 * slow_mean:.1f}ms — tail within normal spread; "
+            "no phase attribution needed",
+        )]
+    dominant = max(excess, key=lambda p: excess[p])
+    ids = ", ".join(r.get("trace_id", "?") for r in slow)
+    decomp = ", ".join(
+        f"{p}+{1e3 * excess[p]:.1f}ms" for p in phases if excess[p]
+    )
+    msg = (
+        f"tail attribution: slowest-{len(slow)} requests average "
+        f"{1e3 * slow_mean:.1f}ms vs p50 {1e3 * p50:.1f}ms over "
+        f"{len(reqs)} traced request(s); the excess is dominated by "
+        f"the {dominant} phase ({decomp}; exemplar trace(s) {ids})"
+    )
+    if dominant in ("admission_wait", "coalesce_wait"):
+        if shed_storm or queue_stall:
+            msg += (
+                " — consistent with the shed/queue findings above: "
+                "the tier is past capacity and requests pay for it "
+                "in queue time; add replicas or lower offered QPS"
+            )
+        else:
+            msg += (
+                " — requests queue before reaching a device; raise "
+                "fleet size or max_batch before blaming the model"
+            )
+    elif dominant == "device":
+        if shed_storm or queue_stall:
+            msg += (
+                " — the shed/queue findings above are a symptom, not "
+                "the cause: the device call itself slowed and the "
+                "backlog followed; profile the engine, not admission"
+            )
+        else:
+            msg += (
+                " — the device call itself is slow for these "
+                "requests; check bucket sizes and engine digests "
+                "(docs/SERVING.md)"
+            )
+    elif dominant == "swap_stall":
+        msg += (
+            " — batches stalled waiting on the rollout swap lock; "
+            "an artifact swap ran during the window (docs/SERVING.md)"
+        )
+    return [Diagnosis("warn", "reqtrace_tail", msg)]
+
+
 def _check_cascade(rows: list[dict]) -> list[Diagnosis]:
     """Retrieval→ranking cascade health from the ``cascade`` stats
     windows (serve/cascade.py; docs/SERVING.md):
@@ -822,6 +942,13 @@ def diagnose(
     findings.extend(_check_serve(
         rows,
         queue_stall_tripped=any(
+            d.code == "serve_queue_stall" for d in findings
+        ),
+    ))
+    findings.extend(_check_reqtrace(
+        rows,
+        shed_storm=any(d.code == "shed_storm" for d in findings),
+        queue_stall=any(
             d.code == "serve_queue_stall" for d in findings
         ),
     ))

@@ -250,6 +250,24 @@ SCHEMA: dict[str, dict[str, Any]] = {
         "e2e_p50": (int, float),
         "e2e_p99": (int, float),
     },
+    # one per KEPT span per reqtrace flush (obs/reqtrace.py;
+    # docs/OBSERVABILITY.md "Tracing a request"): span is "request"
+    # (one request's passage through one fleet stage — trace/span/
+    # parent ids, status ok|error|shed, e2e seconds, the five-phase
+    # decomposition admission_wait/coalesce_wait/swap_stall/featurize/
+    # device, and keep = WHY the sampler kept it: head|slow|error|
+    # shed|tree) or "batch" (one coalesced batch fanning in its
+    # member trace_ids — exactly one engine digest per batch).  The
+    # two variants share only the trunk fields; the rest are
+    # per-variant and OPTIONAL below.
+    "reqtrace": {
+        "t": (int, float),
+        "kind": str,
+        "span": str,
+        "status": str,
+        "phases": dict,
+        "keep": str,
+    },
     # one per continuous-training export/rollout transition
     # (stream/driver.py; docs/CONTINUOUS.md): event is export (a
     # delta/base was cut) / commit (the canary gate passed and the
@@ -359,6 +377,28 @@ OPTIONAL: dict[str, dict[str, Any]] = {
         # (capped exponential backoff) — chaos runs measure RECOVERY,
         # not just rejection; rows from before the field predate it
         "retried": int,
+        # traced runs only (obs/reqtrace.py): client-observed
+        # slowest-3 as {trace_id, e2e_ms, phases_ms?} — the bench
+        # row NAMES its tail so `obs doctor`'s attribution and a
+        # human reading the row point at the same span trees
+        "slowest_exemplars": list,
+    },
+    # per-variant fields (span "request" vs "batch" share only the
+    # trunk — requiring the union would fail every row)
+    "reqtrace": {
+        "trace_id": str,
+        "span_id": str,
+        "parent_span_id": str,
+        "stage": str,
+        "sampled": bool,
+        "e2e": (int, float),
+        "replica": int,
+        "batch": str,
+        "bucket": int,
+        "digest": str,
+        "detail": str,
+        "n": int,
+        "trace_ids": list,
     },
 }
 

@@ -190,6 +190,32 @@ def format_run(run: Run) -> str:
             f"{last.get('rows', 0)} row(s))"
         )
         out.append(line)
+    traces = [
+        r for r in run.kind("reqtrace")
+        if r.get("span") == "request"
+        and isinstance(r.get("phases"), dict) and "e2e" in r
+    ]
+    if traces:
+        def _pct(vals: list[float], q: float) -> float:
+            s = sorted(vals)
+            return s[min(len(s) - 1, int(q * len(s)))]
+        e2e = [float(r["e2e"]) for r in traces]
+        names = sorted({p for r in traces for p in r["phases"]})
+        decomp = "  ".join(
+            f"{p} {1e3 * _pct(vs, 0.5):.1f}/{1e3 * _pct(vs, 0.99):.1f}"
+            for p in names
+            for vs in [[float(r["phases"].get(p, 0.0)) for r in traces]]
+        )
+        kept = {}
+        for r in traces:
+            kept[r.get("keep", "?")] = kept.get(r.get("keep", "?"), 0) + 1
+        out.append(
+            f"reqtrace: {len(traces)} request span(s) "
+            f"({', '.join(f'{k}={v}' for k, v in sorted(kept.items()))}), "
+            f"e2e p50/p99 = {1e3 * _pct(e2e, 0.5):.1f}/"
+            f"{1e3 * _pct(e2e, 0.99):.1f}ms; per-phase p50/p99 ms: "
+            f"{decomp} (docs/OBSERVABILITY.md \"Tracing a request\")"
+        )
     shards = run.shards
     if shards:
         rates = [s.get("examples_per_sec", 0.0) for s in shards]

@@ -218,6 +218,17 @@ def _serve_logger(path: str, digest: str, model: str, tag: str):
     })
 
 
+def _reqtrace_sink(logger, sample: float):
+    """Request-scoped trace sink (obs/reqtrace.py) bound to the tier's
+    metrics stream; None when metrics are off — tracing without a sink
+    to land in would stamp spans nobody can read."""
+    from xflow_tpu.obs.reqtrace import ReqTraceSink
+
+    if logger is None:
+        return None
+    return ReqTraceSink(metrics_logger=logger, sample=sample)
+
+
 def cmd_serve(args) -> int:
     """The production tier: fleet + HTTP front end + watchdog, alive
     until SIGTERM/SIGINT, then a graceful drain through
@@ -246,6 +257,7 @@ def cmd_serve(args) -> int:
     )
     fleet.metrics_logger = logger
     flight.metrics_logger = logger
+    fleet.reqtrace = _reqtrace_sink(logger, args.reqtrace_sample)
     fleet.log_load(args.artifact)
     # chaos fabric (docs/ROBUSTNESS.md): the XFLOW_CHAOS env var arms
     # the serve surface too, with chaos rows in this tier's stream
@@ -381,6 +393,14 @@ def cmd_cascade(args) -> int:
     )
     retrieval.metrics_logger = logger
     ranking.metrics_logger = logger
+    # ONE sink across both stages: a /recommend request keeps one
+    # trace id through retrieval fan-in and the ranking fan-out, so a
+    # span tree reads end-to-end (obs/reqtrace.py)
+    sink = _reqtrace_sink(logger, args.reqtrace_sample)
+    retrieval.reqtrace = sink
+    retrieval.reqtrace_stage = "retrieval"
+    ranking.reqtrace = sink
+    ranking.reqtrace_stage = "ranking"
     cascade = CascadeEngine(
         retrieval, ranking, k=args.k, metrics_logger=logger
     )
@@ -452,6 +472,7 @@ def cmd_loadgen(args) -> int:
     logger = _serve_logger(args.metrics_out, digest, model, "loadgen")
     if fleet is not None:
         fleet.metrics_logger = logger
+        fleet.reqtrace = _reqtrace_sink(logger, args.reqtrace_sample)
         fleet.log_load(args.artifact)
     try:
         summary = run_loadgen(
@@ -464,6 +485,10 @@ def cmd_loadgen(args) -> int:
             table_size=table_size,
             seed=args.seed,
             metrics_logger=logger,
+            # remote tier: no local sink to auto-enable on, so the
+            # flag itself arms client-side minting over the XFS2 wire
+            trace=(args.reqtrace_sample > 0) if args.url else None,
+            trace_sample=args.reqtrace_sample,
         )
     finally:
         if fleet is not None:
@@ -526,6 +551,12 @@ def main(argv: list[str] | None = None) -> int:
             "--depth-budget", type=int, default=256,
             help="admission control: shed when a replica backlog "
             "reaches this depth",
+        )
+        sp.add_argument(
+            "--reqtrace-sample", type=float, default=0.01,
+            help="head-sampling rate for request-scoped traces in "
+            "[0, 1]; errors, sheds, and the window's slowest-k are "
+            "always kept regardless (obs/reqtrace.py)",
         )
         sp.add_argument("--metrics-out", default="")
 

@@ -118,11 +118,12 @@ class MicroBatcher:
         # shared window out from under the other replicas
         self._emit_on_close = emit_on_close
         self._q: queue.Queue = queue.Queue()
-        # FIFO of enqueue stamps mirroring _q (admission-control feed):
-        # submit appends under _submit_lock, the worker pops one per
-        # dequeued request — depth()/queue_age_s() read the backlog
-        # without touching the queue internals
-        self._enq: deque[float] = deque()
+        # FIFO of (enqueue stamp, trace span|None) mirroring _q
+        # (admission-control feed): submit appends under _submit_lock,
+        # the worker pops one per dequeued request — depth()/
+        # queue_age_s()/oldest_trace() read the backlog without
+        # touching the queue internals
+        self._enq: deque[tuple[float, Any]] = deque()
         self._swap_lock = threading.Lock()
         self._submit_lock = threading.Lock()
         self._closed = False
@@ -145,10 +146,13 @@ class MicroBatcher:
 
     # -- request side ------------------------------------------------------
 
-    def submit(self, keys, slots=None, vals=None) -> Future:
+    def submit(self, keys, slots=None, vals=None, trace=None) -> Future:
         """Enqueue one scoring request (raw hash-space features; vals
         default to 1.0 — the hash-mode convention) and return a Future
-        resolving to its pctr."""
+        resolving to its pctr.  ``trace`` is an optional opened
+        ``obs.reqtrace.RequestSpan``: the worker stamps its
+        seal/dequeue/featurize boundaries and completes it when the
+        Future resolves (obs/reqtrace.py)."""
         # the closed-check + put is atomic w.r.t. close(), so every
         # accepted request is enqueued BEFORE the _STOP sentinel and is
         # guaranteed to be scored — no Future can sit behind _STOP
@@ -158,8 +162,10 @@ class MicroBatcher:
                 raise RuntimeError("MicroBatcher is closed")
             fut: Future = Future()
             t = time.perf_counter()
-            self._enq.append(t)
-            self._q.put(((keys, slots, vals), fut, t))
+            if trace is not None:
+                trace.t_enq = t
+            self._enq.append((t, trace))
+            self._q.put(((keys, slots, vals), fut, t, trace))
         return fut
 
     def score(self, keys, slots=None, vals=None) -> float:
@@ -194,7 +200,19 @@ class MicroBatcher:
         with self._submit_lock:
             if not self._enq:
                 return 0.0
-            return now - self._enq[0]
+            return now - self._enq[0][0]
+
+    def oldest_trace(self) -> int | None:
+        """Trace id of the OLDEST still-queued request (None when the
+        backlog is empty or its head request is untraced).  Feeds the
+        serve-channel flight heartbeat below, and through it the
+        watchdog's ``serve_queue_stall`` health rows — so a flight
+        dump names the stuck request, not just the stuck channel."""
+        with self._submit_lock:
+            if not self._enq:
+                return None
+            span = self._enq[0][1]
+        return span.trace_id if span is not None else None
 
     def note_shed(self, cause: str) -> None:
         """Book one admission-control rejection against this batcher's
@@ -337,30 +355,66 @@ class MicroBatcher:
                 with self._submit_lock:
                     self._busy = False
                 if self._flight is not None:
-                    self._flight.note_serve("batch")
+                    # the heartbeat names the oldest still-queued
+                    # request (ISSUE 16): the watchdog copies this
+                    # detail into serve_queue_stall health rows, so a
+                    # stall points at a concrete trace id
+                    tid = self.oldest_trace()
+                    self._flight.note_serve(
+                        "batch" if tid is None
+                        else f"batch oldest_trace={tid:016x}"
+                    )
 
     def _run_batch(self, reqs: list) -> None:
+        # the batch is SEALED here: no later arrival joins it.  The
+        # engine is captured ONCE under the swap lock, so every member
+        # scores on one digest — a batch span can never mix trace ids
+        # across a rollout swap by construction.
+        t_seal = time.perf_counter()
         with self._swap_lock:
             engine = self._engine
         t_deq = time.perf_counter()
         reg = self.registry
-        for _, _, t_enq in reqs:
+        spans = [s for _, _, _, s in reqs if s is not None]
+        sink = spans[0].sink if spans else None
+        bid = sink.next_batch_id() if sink is not None else None
+        for _, _, t_enq, span in reqs:
             reg.observe("serve.queue_seconds", t_deq - t_enq)
+            if span is not None:
+                span.t_seal = t_seal
+                span.t_deq = t_deq
+                span.batch_id = bid
+                span.digest = engine.digest
         try:
             t0 = time.perf_counter()
             # chaos site: a replica whose scoring raises — the batch's
             # futures resolve with the error (below) and the fleet's
             # eviction policy takes it out of routing (serve/fleet.py)
             failpoint("serve.replica_score")
-            batch = engine.featurize([row for row, _, _ in reqs])
+            batch = engine.featurize([row for row, _, _, _ in reqs])
             t1 = time.perf_counter()
+            for span in spans:
+                span.t_feat = t1
             if self._topk:
                 ids, scores, _ = engine.topk_prepared(batch)
             else:
                 pctr = engine.predict_prepared(batch)[: len(reqs)]
             t2 = time.perf_counter()
         except BaseException as e:  # resolve, never wedge the callers
-            for _, fut, _ in reqs:
+            if sink is not None:
+                sink.note_batch(
+                    bid,
+                    [s.trace_id for s in spans],
+                    engine.digest,
+                    0,
+                    {},
+                    status="error",
+                )
+            for _, fut, _, span in reqs:
+                # span first: the error record must exist by the time
+                # the caller observes the failed Future
+                if span is not None:
+                    span.sink.complete(span, "error", detail=repr(e))
                 fut.set_exception(e)
             return
         # featurize/device are shared per batch: every coalesced request
@@ -373,10 +427,29 @@ class MicroBatcher:
         # per-bucket e2e histograms (queue+featurize+device) feed the
         # load generator's p50/p99-per-bucket report (serve/loadgen.py)
         bucket = getattr(batch, "batch_size", len(reqs))
-        for i, (_, fut, t_enq) in enumerate(reqs):
+        if sink is not None:
+            phases = {"featurize": feat, "device": dev}
+            # engine's per-call device split (h2d vs execute) — same
+            # worker thread, so this is the call we just made
+            split = getattr(engine, "last_device_phases", None)
+            if split:
+                phases.update(split)
+            # batch span BEFORE the member resolutions: a caller that
+            # saw its result can already find the complete tree
+            sink.note_batch(
+                bid,
+                [s.trace_id for s in spans],
+                engine.digest,
+                bucket,
+                phases,
+            )
+        for i, (_, fut, t_enq, span) in enumerate(reqs):
             reg.observe("serve.featurize_seconds", feat)
             reg.observe("serve.device_seconds", dev)
             reg.observe(f"serve.e2e.b{bucket}", t2 - t_enq)
+            if span is not None:
+                span.bucket = bucket
+                span.sink.complete(span)
             if self._topk:
                 # the scoring engine's index rides along: candidate
                 # ids are only meaningful against the index that

@@ -115,14 +115,22 @@ class CascadeEngine:
             )
 
     def submit(
-        self, keys, slots=None, vals=None, k: int | None = None
+        self, keys, slots=None, vals=None, k: int | None = None,
+        trace=None,
     ) -> Future:
         """One cascade request: USER-side features in the
         featurize_raw protocol; resolves to ``{"items": [k'], "pctr":
         [k'], "retrieval_scores": [k']}`` ranked by pctr descending.
         Raises :class:`ShedError` at the front door (ranking backlog)
         or from the retrieval stage's admission control; ranking-stage
-        sheds resolve the Future with the ShedError."""
+        sheds resolve the Future with the ShedError.
+
+        ``trace`` is an optional ``obs.reqtrace.TraceContext``: ONE
+        trace id spans both stages — the retrieval span and every
+        candidate's ranking span carry it, so a flushed window shows
+        the whole fan-out as one tree (obs/reqtrace.py).  When the
+        retrieval fleet traces and no context was carried in, one is
+        minted here so in-process cascade callers correlate too."""
         kk = self.k if k is None else int(k)
         if kk < 1:
             raise ValueError(f"k must be >= 1, got {kk}")
@@ -134,11 +142,14 @@ class CascadeEngine:
             if self._closed:
                 raise RuntimeError("CascadeEngine is closed")
             self._requests += 1
+        sink = getattr(self.retrieval, "reqtrace", None)
+        if trace is None and sink is not None:
+            trace = sink.mint()
         self._front_door()
         t0 = time.perf_counter()
         out: Future = Future()
         try:
-            rfut = self.retrieval.submit(keys, slots, vals)
+            rfut = self.retrieval.submit(keys, slots, vals, trace=trace)
         except ShedError:
             with self._lock:
                 self._shed += 1
@@ -146,15 +157,17 @@ class CascadeEngine:
             raise
         user_row = (np.asarray(keys), slots, vals)
         rfut.add_done_callback(
-            lambda f: self._on_retrieved(f, out, user_row, kk, t0)
+            lambda f: self._on_retrieved(f, out, user_row, kk, t0, trace)
         )
         return out
 
     def recommend(
         self, keys, slots=None, vals=None, k: int | None = None,
-        timeout: float | None = 60.0,
+        timeout: float | None = 60.0, trace=None,
     ) -> dict:
-        return self.submit(keys, slots, vals, k=k).result(timeout)
+        return self.submit(keys, slots, vals, k=k, trace=trace).result(
+            timeout
+        )
 
     def _fail(self, out: Future, exc: BaseException) -> None:
         with self._lock:
@@ -163,7 +176,8 @@ class CascadeEngine:
         out.set_exception(exc)
 
     def _on_retrieved(
-        self, rfut: Future, out: Future, user_row, k: int, t0: float
+        self, rfut: Future, out: Future, user_row, k: int, t0: float,
+        trace=None,
     ) -> None:
         """Stage-1 completion (retrieval replica worker thread): book
         the stage latency, assemble user+candidate ranking rows, fan
@@ -266,7 +280,9 @@ class CascadeEngine:
                 np.concatenate([uvals, index["item_vals"][ridx, :m]]),
             )
             try:
-                rk_fut = self.ranking.submit(*row)
+                # same trace id as the retrieval span: the ranking
+                # fan-out IS this request's second stage
+                rk_fut = self.ranking.submit(*row, trace=trace)
             except (ShedError, RuntimeError) as e:
                 with self._lock:
                     self._shed += 1

@@ -174,6 +174,12 @@ class PredictEngine:
         self.item_index: dict | None = None
         self._index_arr = None
         self.topk_k = 0
+        # per-call device split (ISSUE 16): {"h2d": s, "execute": s}
+        # of the LAST prepared call.  Written and read on the one
+        # batcher worker thread that drives this engine clone, so the
+        # batch span (obs/reqtrace.py) can carve its device phase
+        # without a lock.
+        self.last_device_phases: dict | None = None
         if warm:
             self.warm()
 
@@ -558,7 +564,9 @@ class PredictEngine:
         after state."""
         key = (tag, self.topk_k, batch.batch_size, batch.max_nnz,
                batch.hot_nnz)
+        t_call = time.perf_counter()
         arrays = self.step.put_batch(batch, predict=True)
+        t_h2d = time.perf_counter()
         exe = self._compiled.get(key)
         if exe is None:
             with self.obs.phase("serve_compile"):
@@ -572,6 +580,10 @@ class PredictEngine:
             out = jax.tree.map(
                 lambda a: np.asarray(jax.device_get(a)), out
             )
+        self.last_device_phases = {
+            "h2d": t_h2d - t_call,
+            "execute": time.perf_counter() - t_h2d,
+        }
         if self.obs.flight is not None:
             self.obs.flight.note_serve(f"{tag}:b{batch.batch_size}")
         return out
@@ -725,7 +737,9 @@ class PredictEngine:
             from xflow_tpu.parallel.step import validate_compact_batch
 
             validate_compact_batch(batch)
+        t_call = time.perf_counter()
         arrays = self.step.put_batch(batch)  # books the 'h2d' phase
+        t_h2d = time.perf_counter()
         exe = self._compiled.get(key)
         if exe is None:
             with self.obs.phase("serve_compile"):
@@ -745,6 +759,10 @@ class PredictEngine:
                     garr, self.mesh, self.step._bsharding.spec
                 )
             out = np.asarray(jax.device_get(garr))
+        self.last_device_phases = {
+            "h2d": t_h2d - t_call,
+            "execute": time.perf_counter() - t_h2d,
+        }
         if self.obs.flight is not None:
             # serve-channel heartbeat (obs/flight.py): one device call
             # completed — the watchdog's "is scoring moving?" signal,

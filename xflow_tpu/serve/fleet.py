@@ -141,6 +141,7 @@ class ReplicaFleet:
         evict_after_errors: int = 3,
         revive: bool = True,
         topk: bool = False,
+        reqtrace=None,
     ):
         if replicas < 1:
             raise ValueError("a fleet needs at least 1 replica")
@@ -150,6 +151,15 @@ class ReplicaFleet:
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics_logger = metrics_logger
         self.flight = flight
+        # request-scoped tracing (obs/reqtrace.py, ISSUE 16): when a
+        # ReqTraceSink is attached, every submit opens a RequestSpan
+        # (minting a root TraceContext when the caller carried none)
+        # and emit_stats flushes the head+tail-sampled window.  None =
+        # tracing off, zero per-request overhead.  A cascade's two
+        # fleets share ONE sink and override reqtrace_stage so
+        # retrieval/ranking spans of a trace land in the same window.
+        self.reqtrace = reqtrace
+        self.reqtrace_stage = "topk" if topk else "score"
         # top-k fleet (the cascade's retrieval stage): every replica
         # batcher runs the engine's topk leg; submit() Futures resolve
         # to (item_ids, scores).  Mode is fleet-wide — one fleet, one
@@ -331,18 +341,38 @@ class ReplicaFleet:
                 return others[self._rr % len(others)], None
             return healthy[self._seq % len(healthy)], None
 
-    def submit(self, keys, slots=None, vals=None) -> Future:
+    def submit(self, keys, slots=None, vals=None, trace=None) -> Future:
         """Admission-checked enqueue onto one replica; returns the
         pctr Future.  Raises :class:`ShedError` when the replica's
         backlog breaches the deadline budget — the typed backpressure
-        signal, never silently queued past the SLO."""
-        idx, ro_token = self._route()
+        signal, never silently queued past the SLO.  ``trace`` is an
+        optional ``obs.reqtrace.TraceContext`` carried in from the
+        wire; with a sink attached, the span opens HERE (t_arrival)
+        so admission wait + routing are inside the tree — sheds
+        complete immediately with status "shed" (always kept by the
+        sampler)."""
+        sink = self.reqtrace
+        span = (
+            sink.start(trace, self.reqtrace_stage)
+            if sink is not None
+            else None
+        )
+        try:
+            idx, ro_token = self._route()
+        except ShedError as e:
+            if span is not None:
+                sink.complete(span, "shed", detail=e.cause)
+            raise
+        if span is not None:
+            span.replica = idx
         batcher = self.batchers[idx]
         cause = self.policy.check(batcher)
         if cause is not None:
             batcher.note_shed(cause)
             with self._lock:
                 self._shed[cause] = self._shed.get(cause, 0) + 1
+            if span is not None:
+                sink.complete(span, "shed", detail=cause)
             raise ShedError(
                 cause,
                 batcher.depth(),
@@ -350,7 +380,7 @@ class ReplicaFleet:
                 self.policy.describe(),
             )
         t0 = time.perf_counter()
-        fut = batcher.submit(keys, slots, vals)
+        fut = batcher.submit(keys, slots, vals, trace=span)
         with self._lock:
             self._admitted += 1
         fut.add_done_callback(
@@ -860,6 +890,11 @@ class ReplicaFleet:
         if self.metrics_logger is not None:
             self.metrics_logger.log("serve_stats", row)
             self.metrics_logger.log("serve_shed", shed)
+        if self.reqtrace is not None:
+            # trace windows align with stats windows: the same tick
+            # that flushes serve_stats emits the window's sampled
+            # reqtrace rows (errors + sheds + slowest-k + head sample)
+            self.reqtrace.flush()
         if ro is not None:
             # open-rollout heartbeat row: a stream that ends on one of
             # these (no commit/abort after) is what `obs doctor` flags

@@ -172,7 +172,10 @@ class HttpTarget:
                 pass  # HTTP-date form / garbage: keep the fallback
         return min(base * 2.0**attempt, self.backoff_cap_s)
 
-    def submit(self, keys, slots=None, vals=None) -> Future:
+    def submit(self, keys, slots=None, vals=None, trace=None) -> Future:
+        """``trace`` (a ``TraceContext``) rides the packed wire's XFS2
+        traced variant so the tier's reqtrace spans correlate with
+        this client's trace ids (obs/reqtrace.py)."""
         import json
 
         from xflow_tpu.serve.server import (
@@ -181,7 +184,7 @@ class HttpTarget:
         )
 
         fut: Future = Future()
-        body = encode_packed_request([(keys, slots, vals)])
+        body = encode_packed_request([(keys, slots, vals)], trace=trace)
         for attempt in range(self.max_retries + 1):
             try:
                 status, payload, retry_after = self._post(
@@ -223,7 +226,7 @@ class _Recorder:
     """Thread-safe completion sink (callbacks run on replica worker
     threads; workers read nothing until the drain barrier)."""
 
-    def __init__(self) -> None:
+    def __init__(self, slow_k: int = 3) -> None:
         self._lock = threading.Lock()
         self._lat = Histogram(capacity=65536)
         self.submitted = 0
@@ -231,6 +234,11 @@ class _Recorder:
         self.errors = 0
         self.shed: dict[str, int] = {}
         self._shed_total = 0
+        # client-observed slowest-k (e2e seconds, trace id hex) — the
+        # serve_bench row names its slowest exemplars by trace id so a
+        # p99 outlier maps straight onto its reqtrace span tree
+        self._slow_k = slow_k
+        self._slow: list[tuple[float, str]] = []
 
     def note_submit(self) -> None:
         with self._lock:
@@ -248,7 +256,9 @@ class _Recorder:
             self.completed += 1
             self.errors += 1
 
-    def note_done(self, fut: Future, t0: float) -> None:
+    def note_done(
+        self, fut: Future, t0: float, trace_id: str | None = None
+    ) -> None:
         dt = time.perf_counter() - t0
         with self._lock:
             self.completed += 1
@@ -256,6 +266,14 @@ class _Recorder:
                 self.errors += 1
             else:
                 self._lat.observe(dt)
+                if trace_id is not None:
+                    self._slow.append((dt, trace_id))
+                    self._slow.sort(reverse=True)
+                    del self._slow[self._slow_k:]
+
+    def slowest(self) -> list[tuple[float, str]]:
+        with self._lock:
+            return list(self._slow)
 
     def outstanding(self) -> int:
         """Offered requests still awaiting resolution.  Sheds resolved
@@ -289,16 +307,40 @@ def run_loadgen(
     seed: int = 0,
     drain_timeout_s: float = 30.0,
     metrics_logger=None,
+    trace: bool | None = None,
+    trace_sample: float = 0.01,
 ) -> dict:
     """Drive ``target`` (a ReplicaFleet or HttpTarget) with open-loop
     zipf traffic; returns (and optionally logs as ``serve_bench``) the
     SLO summary.  When the target is a fleet, its stats window is
     flushed into the summary (queue/featurize/device + per-bucket
-    percentiles + shed rows)."""
+    percentiles + shed rows).
+
+    Tracing (obs/reqtrace.py): ``trace=None`` auto-enables when the
+    target fleet has a ``reqtrace`` sink attached; ``trace=True``
+    forces client-side minting (e.g. an HttpTarget against a traced
+    tier — ids ride the XFS2 packed wire at ``trace_sample``).  With
+    tracing on, every request carries a trace id and the summary's
+    ``slowest_exemplars`` names the client-observed slowest-3 with
+    their server-side phase breakdowns when available."""
     if offered_qps <= 0 or duration_s <= 0 or concurrency < 1:
         raise ValueError("offered_qps/duration_s/concurrency must be > 0")
     if zipf_a <= 1.0:
         raise ValueError("zipf_a must be > 1 (numpy zipf domain)")
+    sink = getattr(target, "reqtrace", None)
+    if trace is None:
+        trace = sink is not None
+    mint = None
+    if trace:
+        if sink is None:
+            # client-side minting against a remote tier: a local sink
+            # used only for id/sampling-decision generation
+            from xflow_tpu.obs.reqtrace import ReqTraceSink
+
+            sink_local = ReqTraceSink(sample=trace_sample)
+            mint = sink_local.mint
+        else:
+            mint = sink.mint
     if table_size is None:
         cfg = getattr(target, "cfg", None)
         if cfg is None:
@@ -359,9 +401,14 @@ def run_loadgen(
             if delay > 0:
                 time.sleep(delay)
             rec.note_submit()
+            ctx = mint() if mint is not None else None
+            tid = f"{ctx.trace_id:016x}" if ctx is not None else None
             t0 = time.perf_counter()
             try:
-                fut = target.submit(*rows[j])
+                if ctx is not None:
+                    fut = target.submit(*rows[j], trace=ctx)
+                else:
+                    fut = target.submit(*rows[j])
             except ShedError as e:
                 rec.note_shed(e.cause)
                 continue
@@ -373,7 +420,7 @@ def run_loadgen(
                 rec.note_error()
                 continue
             fut.add_done_callback(
-                lambda f, t0=t0: rec.note_done(f, t0)
+                lambda f, t0=t0, tid=tid: rec.note_done(f, t0, tid)
             )
 
     threads = [
@@ -445,6 +492,20 @@ def run_loadgen(
             summary[f] = stats[f]
         summary["per_bucket"] = stats.get("per_bucket", {})
         summary["compiles"] = target.engines[0].compile_count
+        if trace and sink is not None:
+            # emit_stats just flushed the sink's window, so server-side
+            # phase breakdowns for the client's slowest trace ids are
+            # available via the last-flush exemplar view
+            exemplars = []
+            for dt, tid in rec.slowest():
+                e: dict[str, Any] = {
+                    "trace_id": tid, "e2e_ms": round(dt * 1e3, 3),
+                }
+                ph = sink.phases_of(tid)
+                if ph is not None:
+                    e["phases_ms"] = ph
+                exemplars.append(e)
+            summary["slowest_exemplars"] = exemplars
     else:
         for f in (
             "queue_p50", "queue_p99", "featurize_p50", "featurize_p99",
@@ -452,6 +513,13 @@ def run_loadgen(
         ):
             summary[f] = 0.0
         summary["compiles"] = 0
+        if trace:
+            # remote tier: client e2e only — the server's phase
+            # breakdowns live in ITS reqtrace stream under these ids
+            summary["slowest_exemplars"] = [
+                {"trace_id": tid, "e2e_ms": round(dt * 1e3, 3)}
+                for dt, tid in rec.slowest()
+            ]
     if metrics_logger is not None:
         metrics_logger.log("serve_bench", summary)
     return summary

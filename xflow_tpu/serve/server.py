@@ -28,7 +28,12 @@ Packed wire (little-endian): request ``b"XFS1" u32 nrows`` then per
 row ``u16 nnz, nnz*u64 keys, nnz*u32 slots, nnz*f32 vals``; response
 ``u32 n, n*f32 pctr``.  ``encode_packed_request`` /
 ``decode_packed_response`` are the client halves (serve/loadgen.py
-uses them).
+uses them).  A traced request uses magic ``b"XFS2"`` with a 17-byte
+trace triple (``u64 trace_id, u64 parent_span_id, u8 sampled``)
+between the magic and ``nrows`` — the packed-wire twin of the
+``X-XFlow-Trace`` header (obs/reqtrace.py); either way the response
+echoes the trace id in an ``X-XFlow-Trace`` response header so
+clients can name their slow requests.
 
 Liveness: the accept loop beats the flight recorder's ``http`` channel
 from ``service_actions`` (called every poll of ``serve_forever``), so
@@ -58,9 +63,13 @@ import numpy as np
 from concurrent.futures import TimeoutError as FutureTimeout
 
 from xflow_tpu.chaos import ChaosError, failpoint
+from xflow_tpu.obs.reqtrace import TraceContext, format_header, parse_header
 from xflow_tpu.serve.fleet import ReplicaFleet, RolloutError, ShedError
 
 PACKED_MAGIC = b"XFS1"
+# traced packed request (ISSUE 16): magic + u64 trace_id + u64
+# parent_span_id + u8 sampled, then the XFS1 body from nrows on
+PACKED_TRACE_MAGIC = b"XFS2"
 # how long a handler waits on its scoring futures before 504
 SCORE_TIMEOUT_S = 60.0
 
@@ -68,10 +77,24 @@ SCORE_TIMEOUT_S = 60.0
 # -- packed wire --------------------------------------------------------------
 
 
-def encode_packed_request(rows: list) -> bytes:
+def encode_packed_request(rows: list, trace=None) -> bytes:
     """Rows are ``(keys, slots, vals)`` tuples (slots/vals may be
-    None) or bare key arrays — the ``featurize_raw`` row protocol."""
-    out = [PACKED_MAGIC, struct.pack("<I", len(rows))]
+    None) or bare key arrays — the ``featurize_raw`` row protocol.
+    With ``trace`` (a ``TraceContext``) the XFS2 traced variant is
+    emitted so the server correlates its spans with this client."""
+    if trace is None:
+        out = [PACKED_MAGIC, struct.pack("<I", len(rows))]
+    else:
+        out = [
+            PACKED_TRACE_MAGIC,
+            struct.pack(
+                "<QQB",
+                trace.trace_id,
+                trace.parent_span_id,
+                1 if trace.sampled else 0,
+            ),
+            struct.pack("<I", len(rows)),
+        ]
     for row in rows:
         keys, slots, vals = row if isinstance(row, tuple) else (
             row, None, None
@@ -96,12 +119,32 @@ def encode_packed_request(rows: list) -> bytes:
 
 
 def decode_packed_request(buf: bytes) -> list[tuple]:
-    if buf[:4] != PACKED_MAGIC:
+    """Rows only — the pre-tracing signature every existing caller
+    holds; traced callers use :func:`decode_packed_request_traced`."""
+    return decode_packed_request_traced(buf)[0]
+
+
+def decode_packed_request_traced(
+    buf: bytes,
+) -> tuple[list[tuple], TraceContext | None]:
+    """(rows, trace) — ``trace`` is None for the untraced XFS1 magic."""
+    trace: TraceContext | None = None
+    off = 4
+    if buf[:4] == PACKED_TRACE_MAGIC:
+        if len(buf) < 25:  # magic + trace triple + nrows
+            raise ValueError("truncated packed request (trace triple)")
+        tid, pid, flag = struct.unpack_from("<QQB", buf, off)
+        if tid == 0 or flag not in (0, 1):
+            raise ValueError("bad packed-request trace triple")
+        trace = TraceContext(tid, pid, bool(flag))
+        off += 17
+    elif buf[:4] != PACKED_MAGIC:
         raise ValueError(
-            f"bad packed-request magic {buf[:4]!r} (want {PACKED_MAGIC!r})"
+            f"bad packed-request magic {buf[:4]!r} (want {PACKED_MAGIC!r}"
+            f" or {PACKED_TRACE_MAGIC!r})"
         )
-    (nrows,) = struct.unpack_from("<I", buf, 4)
-    off = 8
+    (nrows,) = struct.unpack_from("<I", buf, off)
+    off += 4
     rows: list[tuple] = []
     for _ in range(nrows):
         if off + 2 > len(buf):
@@ -122,7 +165,7 @@ def decode_packed_request(buf: bytes) -> list[tuple]:
         raise ValueError(
             f"packed request has {len(buf) - off} trailing byte(s)"
         )
-    return rows
+    return rows, trace
 
 
 def encode_packed_response(pctr: np.ndarray) -> bytes:
@@ -244,12 +287,34 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- scoring ------------------------------------------------------------
 
-    def _score_rows(self, rows: list[tuple]) -> np.ndarray:
+    def _trace_ctx(self, fleet, wire=None) -> TraceContext | None:
+        """The request's TraceContext at the front door: a packed-wire
+        triple beats the ``X-XFlow-Trace`` header beats minting fresh
+        (only when the target fleet traces at all — no sink, no ids).
+        A malformed header is treated as absent, never a 400: a bad
+        trace annotation must not fail the request it rides."""
+        if wire is not None:
+            return wire
+        ctx = parse_header(self.headers.get("X-XFlow-Trace"))
+        if ctx is not None:
+            return ctx
+        sink = getattr(fleet, "reqtrace", None)
+        return sink.mint() if sink is not None else None
+
+    def _trace_headers(self, ctx) -> dict[str, str] | None:
+        """Echo the trace id on the response so clients correlate."""
+        return None if ctx is None else {
+            "X-XFlow-Trace": format_header(ctx)
+        }
+
+    def _score_rows(self, rows: list[tuple], trace=None) -> np.ndarray:
         """All-or-nothing admission: the first shed fails the whole
         request (already-admitted rows still score and resolve — the
-        batcher drains them — but the client is told to back off)."""
+        batcher drains them — but the client is told to back off).
+        Every row of one HTTP request rides ONE trace id (each gets
+        its own span)."""
         fleet = self.tier.fleet
-        futs = [fleet.submit(*row) for row in rows]
+        futs = [fleet.submit(*row, trace=trace) for row in rows]
         deadline = time.perf_counter() + SCORE_TIMEOUT_S
         return np.asarray([
             f.result(timeout=max(0.001, deadline - time.perf_counter()))
@@ -285,17 +350,20 @@ class _Handler(BaseHTTPRequestHandler):
                 # — a client problem, not a server fault (400 not 500)
                 raise ValueError(f"bad row field: {e}") from None
             rows.append((keys, slots, vals))
-        pctr = self._score_rows(rows)
+        ctx = self._trace_ctx(self.tier.fleet)
+        pctr = self._score_rows(rows, trace=ctx)
         self._json(200, {
             "pctr": [round(float(p), 6) for p in pctr],
             "digest": self.tier.fleet.digest,
-        })
+        }, headers=self._trace_headers(ctx))
 
     def _handle_score_packed(self, body: bytes) -> None:
-        rows = decode_packed_request(body)
-        pctr = self._score_rows(rows)
+        rows, wire_ctx = decode_packed_request_traced(body)
+        ctx = self._trace_ctx(self.tier.fleet, wire=wire_ctx)
+        pctr = self._score_rows(rows, trace=ctx)
         self._respond(
-            200, encode_packed_response(pctr), "application/octet-stream"
+            200, encode_packed_response(pctr), "application/octet-stream",
+            headers=self._trace_headers(ctx),
         )
 
     # -- HTTP verbs ---------------------------------------------------------
@@ -424,7 +492,8 @@ class _Handler(BaseHTTPRequestHandler):
         doc = json.loads(body.decode())
         rows = self._request_rows(doc)
         k = self._request_k(doc)
-        futs = [fleet.submit(*row) for row in rows]
+        ctx = self._trace_ctx(fleet)
+        futs = [fleet.submit(*row, trace=ctx) for row in rows]
         deadline = time.perf_counter() + SCORE_TIMEOUT_S
         items, scores = [], []
         for f in futs:
@@ -439,7 +508,7 @@ class _Handler(BaseHTTPRequestHandler):
             "items": items,
             "scores": scores,
             "digest": fleet.digest,
-        })
+        }, headers=self._trace_headers(ctx))
 
     def _handle_recommend(self, body: bytes) -> None:
         """The cascade front door: USER features -> retrieval top-k ->
@@ -453,8 +522,11 @@ class _Handler(BaseHTTPRequestHandler):
             raise ValueError(
                 f"recommend takes exactly one row, got {len(rows)}"
             )
-        result = casc.recommend(*rows[0], k=self._request_k(doc))
-        self._json(200, result)
+        ctx = self._trace_ctx(casc.retrieval)
+        result = casc.recommend(
+            *rows[0], k=self._request_k(doc), trace=ctx
+        )
+        self._json(200, result, headers=self._trace_headers(ctx))
 
     def _do_post(self) -> None:
         try:
