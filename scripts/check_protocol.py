@@ -1,0 +1,141 @@
+"""Wire-protocol gate: the XF016–XF020 static pass PLUS the seeded
+decoder fuzzer, pre-gating the pod-scale store (ROADMAP item 2) and
+the persistent binary serve transport (ROADMAP item 5) before those
+formats cross real sockets and failure domains.
+
+Run from the repo root:
+
+    python scripts/check_protocol.py
+    python scripts/check_protocol.py --write-registry   # after a
+        deliberate wire-format change (version/magic bump)
+
+Two halves, both must pass:
+
+1. **Static** — ``xflow_tpu.analysis`` with the five protocol rules
+   (XF016 codec parity + registry fingerprints, XF017 blocking-I/O
+   timeouts, XF018 failpoint coverage, XF019 determinism taint, XF020
+   explicit endianness — docs/ANALYSIS.md) over the whole package
+   against the committed baseline, same contract as
+   scripts/check_analysis.py.  The wire fingerprints (magic
+   constants, format-version constants, struct format strings per
+   module) are pinned by ``protocol-registry.json``: an unregistered
+   format change fails here, and ``--write-registry`` is the explicit
+   "yes, I bumped the version" acknowledgement that refreshes it.
+2. **Runtime** — analysis/wirefuzz.py drives every wire decoder
+   (XFS1, XFS2, packed-v2, binary CSR, delta manifest) through
+   ``FUZZ_ROUNDS`` seeded structure-aware mutations each; any untyped
+   exception, over-budget case, or accepted-but-rewritten payload
+   fails the gate.
+
+Wired into tier-1 via tests/test_analysis.py, next to
+check_analysis.py / check_concurrency.py / check_memory.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PROTOCOL_RULES = ["XF016", "XF017", "XF018", "XF019", "XF020"]
+
+# fixed gate seed + per-decoder mutation count (acceptance bar: >= 200
+# mutations per decoder; keep a margin over it)
+FUZZ_SEED = 0xC0FFEE
+FUZZ_ROUNDS = 220
+
+
+def write_registry(package: str, registry_path: str) -> int:
+    from xflow_tpu.analysis.core import PackageIndex
+    from xflow_tpu.analysis.rules_protocol import build_registry
+
+    modules = build_registry(PackageIndex([package]))
+    doc = {
+        "comment": (
+            "Wire-format fingerprints per module (magic constants, "
+            "format-version constants, struct format strings) — the "
+            "XF016 registry.  A format change MUST come with a "
+            "version/magic bump and a refresh via "
+            "`python scripts/check_protocol.py --write-registry`; "
+            "an unregistered drift fails scripts/check_protocol.py."
+        ),
+        "modules": modules,
+    }
+    with open(registry_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(
+        f"wrote {os.path.relpath(registry_path, REPO)}: "
+        f"{len(modules)} wire module(s)"
+    )
+    return 0
+
+
+def check_static(package: str, baseline_path: str) -> int:
+    from xflow_tpu.analysis import (
+        load_baseline,
+        render_text,
+        run_analysis,
+        split_baselined,
+    )
+
+    findings, pragma_suppressed = run_analysis(
+        [package], select=PROTOCOL_RULES
+    )
+    entries = [
+        e
+        for e in load_baseline(baseline_path)
+        if e["rule"] in PROTOCOL_RULES
+    ]
+    new, grandfathered, stale = split_baselined(findings, entries)
+    print(render_text(new, grandfathered, pragma_suppressed, stale))
+    if new:
+        return 1
+    if stale:
+        print(
+            "FAIL: stale baseline entries (prune analysis-baseline.json)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def check_runtime() -> int:
+    """Every wire decoder under the seeded fuzzer: typed errors only,
+    no hang, no silently-rewritten accepted payload."""
+    from xflow_tpu.analysis.wirefuzz import render_report, run_wirefuzz
+
+    report = run_wirefuzz(seed=FUZZ_SEED, rounds=FUZZ_ROUNDS)
+    print(render_report(report))
+    if not report["ok"]:
+        print(
+            "FAIL: a wire decoder raised an untyped error, blew the "
+            "per-case budget, or silently accepted a rewritten "
+            "payload (see failures above)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"OK: {len(report['targets'])} decoder(s) x {FUZZ_ROUNDS} "
+        "mutation(s) — typed refusals only"
+    )
+    return 0
+
+
+def main(argv: list[str]) -> int:
+    package = os.path.join(REPO, "xflow_tpu")
+    baseline = os.path.join(REPO, "analysis-baseline.json")
+    registry = os.path.join(REPO, "protocol-registry.json")
+    if "--write-registry" in argv:
+        return write_registry(package, registry)
+    rc = check_static(package, baseline)
+    rc = check_runtime() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

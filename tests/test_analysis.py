@@ -1425,3 +1425,317 @@ def test_metrics_logger_concurrent_log_no_torn_lines(tmp_path):
     assert len(rows) == n_threads * per_thread
     seen = {(r["tid"], r["i"]) for r in rows}
     assert len(seen) == n_threads * per_thread
+
+
+# -- XF016-XF020: wire-protocol & failure-domain rules (ISSUE 18) ----------
+
+
+def test_xf016_pack_without_unpack_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"wire.py": (
+        "import struct\n"
+        "def emit(n):\n"
+        "    return struct.pack('<I', n)\n"
+    )}, select=["XF016"])
+    assert [f.rule for f in findings] == ["XF016"]
+    assert "never unpacked" in findings[0].message
+
+
+def test_xf016_unpack_without_pack_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"wire.py": (
+        "import struct\n"
+        "def read(buf):\n"
+        "    return struct.unpack('<I', buf)\n"
+    )}, select=["XF016"])
+    assert [f.rule for f in findings] == ["XF016"]
+    assert "never packed" in findings[0].message
+
+
+def test_xf016_cross_module_parity_is_silent(tmp_path):
+    # encoder and decoder in DIFFERENT files: parity is tree-wide
+    findings, _ = scan(tmp_path, {
+        "enc.py": (
+            "import struct\n"
+            "def emit(n):\n"
+            "    return struct.pack('<I', n)\n"
+        ),
+        "dec.py": (
+            "import struct\n"
+            "def read(buf):\n"
+            "    return struct.unpack('<I', buf)\n"
+        ),
+    }, select=["XF016"])
+    assert findings == []
+
+
+def test_xf016_struct_object_binding_counts(tmp_path):
+    # a Struct-bound NAME.pack/.unpack pairs up like the module calls
+    findings, _ = scan(tmp_path, {"wire.py": (
+        "import struct\n"
+        "HDR = struct.Struct('<QQ')\n"
+        "def emit(a, b):\n"
+        "    return HDR.pack(a, b)\n"
+        "def read(buf):\n"
+        "    return HDR.unpack(buf)\n"
+    )}, select=["XF016"])
+    assert findings == []
+
+
+def test_xf016_registry_drift_and_unregistered_module(tmp_path):
+    src = {
+        "wire.py": (
+            "import struct\n"
+            "MAGIC = b'TT01'\n"
+            "def emit(n):\n"
+            "    return struct.pack('<I', n)\n"
+            "def read(buf):\n"
+            "    return struct.unpack('<I', buf)\n"
+        ),
+    }
+    # no registry file next to the root: the registry half is unarmed
+    findings, _ = scan(tmp_path, src, select=["XF016"])
+    assert findings == []
+    # registry present and matching: silent
+    (tmp_path / "protocol-registry.json").write_text(json.dumps({
+        "modules": {"wire.py": {
+            "magics": {"MAGIC": b"TT01".hex()},
+            "versions": {},
+            "formats": ["<I"],
+        }},
+    }))
+    findings, _ = run_analysis([str(tmp_path)], select=["XF016"])
+    assert findings == []
+    # registry present but the magic drifted: fires
+    (tmp_path / "protocol-registry.json").write_text(json.dumps({
+        "modules": {"wire.py": {
+            "magics": {"MAGIC": b"TT99".hex()},
+            "versions": {},
+            "formats": ["<I"],
+        }},
+    }))
+    findings, _ = run_analysis([str(tmp_path)], select=["XF016"])
+    assert [f.rule for f in findings] == ["XF016"]
+    assert "drifted" in findings[0].message and "magics" in findings[0].message
+    # unregistered wire module: fires
+    (tmp_path / "protocol-registry.json").write_text(
+        json.dumps({"modules": {}})
+    )
+    findings, _ = run_analysis([str(tmp_path)], select=["XF016"])
+    assert any("not registered" in f.message for f in findings)
+
+
+def test_xf017_unbounded_result_in_serve_domain_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"serve/front.py": (
+        "def score(fut):\n"
+        "    return fut.result()\n"
+    )}, select=["XF017"])
+    assert [f.rule for f in findings] == ["XF017"]
+    assert findings[0].line == 2
+
+
+def test_xf017_timeout_and_out_of_domain_are_silent(tmp_path):
+    findings, _ = scan(tmp_path, {
+        # same domain, bounded: silent
+        "serve/front.py": (
+            "def score(fut):\n"
+            "    return fut.result(timeout=5.0)\n"
+        ),
+        # unbounded but OUTSIDE serve/stream/store: not this rule's
+        # domain (the training loop may legitimately block)
+        "ops/math.py": (
+            "def gather(fut):\n"
+            "    return fut.result()\n"
+        ),
+    }, select=["XF017"])
+    assert findings == []
+
+
+def test_xf017_http_ctor_without_timeout_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"serve/client.py": (
+        "import http.client\n"
+        "def dial(host):\n"
+        "    return http.client.HTTPConnection(host)\n"
+        "def dial_bounded(host):\n"
+        "    return http.client.HTTPConnection(host, timeout=10.0)\n"
+    )}, select=["XF017"])
+    assert [f.rule for f in findings] == ["XF017"]
+    assert findings[0].line == 3
+
+
+def test_xf017_bare_queue_get_fires_dict_get_silent(tmp_path):
+    findings, _ = scan(tmp_path, {"stream/pump.py": (
+        "def drain(q, d):\n"
+        "    x = q.get()\n"
+        "    y = d.get('k', 0)\n"  # dict.get carries args: not blocking
+        "    return x, y\n"
+    )}, select=["XF017"])
+    assert [f.rule for f in findings] == ["XF017"]
+    assert findings[0].line == 2
+
+
+def test_xf018_uncovered_io_fires_and_failpoint_covers(tmp_path):
+    findings, _ = scan(tmp_path, {"io/reader.py": (
+        "def read_raw(path):\n"
+        "    with open(path, 'rb') as f:\n"
+        "        return f.read()\n"
+    )}, select=["XF018"])
+    assert [f.rule for f in findings] == ["XF018"]
+    assert findings[0].line == 2  # anchored at the I/O call, not the def
+    # a failpoint in the function itself covers it (fresh tree: scan
+    # roots accumulate files otherwise)
+    findings, _ = scan(tmp_path / "covered", {"io/covered.py": (
+        "from xflow_tpu.chaos import failpoint\n"
+        "def read_raw(path):\n"
+        "    failpoint('reader.read')\n"
+        "    with open(path, 'rb') as f:\n"
+        "        return f.read()\n"
+    )}, select=["XF018"])
+    assert findings == []
+
+
+def test_xf018_transitive_caller_coverage(tmp_path):
+    # the failpoint sits in the CALLER: the callee's boundary is on an
+    # injected path, so it is covered
+    findings, _ = scan(tmp_path, {"io/stack.py": (
+        "from xflow_tpu.chaos import failpoint\n"
+        "def _raw(path):\n"
+        "    with open(path, 'rb') as f:\n"
+        "        return f.read()\n"
+        "def fetch(path):\n"
+        "    failpoint('stack.fetch')\n"
+        "    return _raw(path)\n"
+    )}, select=["XF018"])
+    assert findings == []
+
+
+def test_xf018_outside_chaos_domain_silent(tmp_path):
+    findings, _ = scan(tmp_path, {"obs/dump.py": (
+        "def write(path, s):\n"
+        "    with open(path, 'w') as f:\n"
+        "        f.write(s)\n"
+    )}, select=["XF018"])
+    assert findings == []
+
+
+def test_xf019_wall_clock_into_digest_fires(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import hashlib\n"
+        "import time\n"
+        "def stamp():\n"
+        "    h = hashlib.sha256()\n"
+        "    t = time.time()\n"
+        "    h.update(str(t).encode())\n"
+        "    return h.hexdigest()\n"
+    )}, select=["XF019"])
+    assert [f.rule for f in findings] == ["XF019"]
+    assert "wall-clock/random" in findings[0].message
+
+
+def test_xf019_taint_through_assignment_chain(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import hashlib\n"
+        "import uuid\n"
+        "def tag(payload):\n"
+        "    nonce = uuid.uuid4()\n"
+        "    salted = payload + str(nonce)\n"
+        "    return hashlib.sha256(salted.encode()).hexdigest()\n"
+    )}, select=["XF019"])
+    assert [f.rule for f in findings] == ["XF019"]
+
+
+def test_xf019_deterministic_digest_silent(tmp_path):
+    findings, _ = scan(tmp_path, {"mod.py": (
+        "import hashlib\n"
+        "import time\n"
+        "def digest(payload):\n"
+        "    t0 = time.perf_counter()\n"  # timed, but never fed in
+        "    h = hashlib.sha256(payload)\n"
+        "    _ = time.perf_counter() - t0\n"
+        "    return h.hexdigest()\n"
+    )}, select=["XF019"])
+    assert findings == []
+
+
+def test_xf020_native_order_fires_explicit_silent(tmp_path):
+    findings, _ = scan(tmp_path, {"wire.py": (
+        "import struct\n"
+        "def emit(n, m, k):\n"
+        "    a = struct.pack('I', n)\n"   # native order+size: fires
+        "    b = struct.pack('=I', m)\n"  # native order: fires
+        "    c = struct.pack('<I', k)\n"  # explicit: silent
+        "    return a + b + c\n"
+    )}, select=["XF020"])
+    assert [f.rule for f in findings] == ["XF020", "XF020"]
+    lines = sorted(f.line for f in findings)
+    assert lines == [3, 4]
+
+
+def test_protocol_rules_pragma_suppression(tmp_path):
+    findings, suppressed = scan(tmp_path, {"serve/front.py": (
+        "def score(fut):\n"
+        "    # sentinel-drain: producer closes the queue (xf: ignore[XF017])\n"
+        "    return fut.result()\n"
+    )}, select=["XF017"])
+    assert findings == []
+    assert [f.rule for f in suppressed] == ["XF017"]
+
+
+# -- wirefuzz: the runtime companion (analysis/wirefuzz.py) ----------------
+
+
+def test_wirefuzz_deterministic_and_clean():
+    """Same seed -> byte-identical mutation stream (the gate's
+    reproducibility contract) and the shipped decoders refuse every
+    mutant with a typed error."""
+    from xflow_tpu.analysis.wirefuzz import run_wirefuzz
+
+    a = run_wirefuzz(seed=5, rounds=25)
+    b = run_wirefuzz(seed=5, rounds=25)
+    assert a["mutation_digest"] == b["mutation_digest"]
+    assert a["ok"] and b["ok"], (a, b)
+    assert set(a["targets"]) == {
+        "xfs1", "xfs2", "packed_v2", "binary_csr", "delta_manifest"
+    }
+    for name, t in a["targets"].items():
+        c = t["counts"]
+        assert c["untyped"] == 0 and c["slow"] == 0, (name, t)
+        assert c["typed"] + c["accepted"] + c["accepted_mismatch"] == 25
+    # a different seed explores a different mutation stream
+    c = run_wirefuzz(seed=6, rounds=25)
+    assert c["mutation_digest"] != a["mutation_digest"]
+
+
+def test_wirefuzz_flags_untyped_and_hang(tmp_path):
+    """The fuzzer itself is honest: a decoder that raises an UNTYPED
+    error (or sleeps past the case budget) is a failure, not a pass."""
+    from xflow_tpu.analysis import wirefuzz
+    from xflow_tpu.analysis.wirefuzz import (
+        FuzzTarget,
+        SplitMix64,
+        fuzz_target,
+    )
+    import hashlib
+
+    def bad_decode(buf):
+        if buf != b"GOOD":
+            raise OverflowError("boom")  # not in TYPED_ERRORS
+
+    t = FuzzTarget("bad", b"GOOD", bad_decode)
+    report = fuzz_target(t, SplitMix64(1), 10, hashlib.sha256())
+    assert not report["ok"]
+    assert report["counts"]["untyped"] > 0
+    assert any("OverflowError" in f["detail"] for f in report["failures"])
+    assert wirefuzz.TYPED_ERRORS == (ValueError, KeyError, __import__("struct").error)
+
+
+def test_check_protocol_script():
+    """The wire-protocol gate (XF016-XF020 static + seeded decoder
+    fuzz) passes on the shipped tree — run exactly as CI does."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_protocol.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "typed refusals only" in proc.stdout

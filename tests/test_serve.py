@@ -1230,6 +1230,87 @@ def test_http_malformed_score_bodies_are_400(lr_served):
         tier.close()
 
 
+def test_http_packed_wire_fuzz_corpus_is_400(lr_served):
+    """Fuzz-regression corpus (analysis/wirefuzz.py mutation classes)
+    pinned over LIVE HTTP: truncated XFS2 trace header, XFS1<->XFS2
+    magic confusion, inflated nnz/row counts, unknown magic, and
+    trailing bytes each answer a typed 400 — never a 500, never a
+    hang — and the tier keeps serving afterwards."""
+    import struct as _struct
+    import urllib.error
+    import urllib.request
+
+    from xflow_tpu.obs.reqtrace import TraceContext
+    from xflow_tpu.serve.engine import PredictEngine
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.server import (
+        PACKED_MAGIC,
+        PACKED_TRACE_MAGIC,
+        ServeTier,
+        encode_packed_request,
+    )
+
+    rows = [(np.asarray([3, 99], np.int64), None, None)]
+    plain = encode_packed_request(rows)
+    traced = encode_packed_request(
+        rows, trace=TraceContext(0x1234_5678_9ABC_DEF0, 17, True)
+    )
+    corpus = {
+        # XFS2 magic but the 17-byte trace triple is cut short
+        "truncated_trace_header": PACKED_TRACE_MAGIC + traced[4:12],
+        # traced body presented as XFS1: the trace triple's low u32
+        # (0x9ABCDEF0) is read as an absurd nrows -> typed truncation
+        "magic_confusion_xfs2_as_xfs1": PACKED_MAGIC + traced[4:],
+        # untraced body presented as XFS2: row bytes parse as a trace
+        # triple + garbage counts
+        "magic_confusion_xfs1_as_xfs2": PACKED_TRACE_MAGIC + plain[4:],
+        # row header claims 0xFFFF nnz with 8 payload bytes behind it
+        "oversized_nnz": (
+            PACKED_MAGIC + _struct.pack("<I", 1)
+            + _struct.pack("<H", 0xFFFF) + b"\x00" * 8
+        ),
+        # nrows inflated past the single row actually shipped
+        "oversized_nrows": (
+            PACKED_MAGIC + _struct.pack("<I", 1 << 20) + plain[8:]
+        ),
+        "unknown_magic": b"XFQ9" + plain[4:],
+        "trailing_bytes": plain + b"\x00",
+        "empty_body": b"",
+    }
+
+    engine = PredictEngine.load(lr_served["artifact"], buckets=(8,), warm=False)
+    fleet = ReplicaFleet(engine, replicas=1)
+    tier = ServeTier(fleet, port=0, poll_s=0.05).start()
+    try:
+        url = tier.address + "/v1/score_packed"
+        for name, blob in corpus.items():
+            req = urllib.request.Request(
+                url, data=blob,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    code, body = r.status, r.read()
+            except urllib.error.HTTPError as e:
+                code, body = e.code, e.read()
+            assert code == 400, (name, code, body)
+            doc = json.loads(body.decode())
+            # the 400 names the exception type (the typed-error
+            # taxonomy the fuzzer enforces), not a stack trace
+            assert doc["error"].split(":")[0] in (
+                "ValueError", "KeyError", "error",  # struct.error
+            ), (name, doc)
+        # a pristine request still scores: the corpus poisoned nothing
+        req = urllib.request.Request(
+            url, data=plain,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+    finally:
+        tier.close()
+
+
 def test_route_striping_starves_no_replica_and_gates_ignore_stragglers(
     lr_served,
 ):

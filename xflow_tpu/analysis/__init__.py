@@ -63,6 +63,29 @@ caps (T/B/K/Kh/H/S/D) through jitted traces:
   north-star geometry gated against the committed
   ``memory-budget.json`` (scripts/check_memory.py).
 
+Wire-protocol & failure-domain rules (ISSUE 18; rules_protocol.py)
+pre-gate the pod-scale store and binary serve transport — the formats
+that will cross real sockets and failure domains:
+
+* XF016 codec parity — every struct format packed somewhere must be
+  unpacked somewhere (and vice versa), and each wire module's
+  fingerprint (magics, format-version constants, struct formats) must
+  match the committed ``protocol-registry.json``;
+* XF017 blocking-I/O timeout discipline — ``.result()``/``.wait()``/
+  bare ``.get()`` and HTTP/socket constructors in serve/stream/store
+  must carry a timeout (Config ``serve_*_timeout_s`` knobs);
+* XF018 failpoint coverage — file-I/O boundaries in the chaos-covered
+  modules must be reachable from a ``failpoint(...)`` site;
+* XF019 determinism taint — wall-clock/random values must not flow
+  into digest computations;
+* XF020 explicit endianness — struct format literals must pin byte
+  order (``<``/``>``/``!``).
+
+Runtime companion: analysis/wirefuzz.py, a seeded structure-aware
+decoder fuzzer over every wire format (XFS1/XFS2, packed-v2, binary
+CSR, delta manifests) asserting typed refusals only; both halves gate
+in scripts/check_protocol.py.
+
 Suppression: ``# xf: ignore[XF001]`` on the finding line, or
 ``# xf: ignore-file[XF001]`` anywhere in the file; a committed baseline
 file (``analysis-baseline.json``) grandfathers legacy findings without
@@ -91,9 +114,24 @@ from xflow_tpu.analysis.rules_memory import (
     find_budget,
     load_budget,
 )
+from xflow_tpu.analysis.rules_protocol import (
+    PROTOCOL_RULES,
+    build_registry,
+    find_registry,
+    load_registry,
+    wire_fingerprint,
+)
 from xflow_tpu.analysis.sanitizer import LockOrderSanitizer
+from xflow_tpu.analysis.wirefuzz import render_report, run_wirefuzz
 
 __all__ = [
+    "PROTOCOL_RULES",
+    "build_registry",
+    "find_registry",
+    "load_registry",
+    "wire_fingerprint",
+    "run_wirefuzz",
+    "render_report",
     "estimate_transients",
     "find_budget",
     "load_budget",

@@ -201,6 +201,13 @@ def all_rules() -> list[Rule]:
         ShardingCoverage,
         TransientBudget,
     )
+    from xflow_tpu.analysis.rules_protocol import (
+        BlockingIoTimeout,
+        CodecParity,
+        DeterminismTaint,
+        ExplicitEndian,
+        FailpointCoverage,
+    )
     from xflow_tpu.analysis.rules_robustness import SwallowedWorkerException
     from xflow_tpu.analysis.rules_schema import SchemaDrift
     from xflow_tpu.analysis.rules_threads import LockDiscipline
@@ -221,6 +228,11 @@ def all_rules() -> list[Rule]:
         DonationSafety(),
         TransientBudget(),
         SwallowedWorkerException(),
+        CodecParity(),
+        BlockingIoTimeout(),
+        FailpointCoverage(),
+        DeterminismTaint(),
+        ExplicitEndian(),
     ]
 
 

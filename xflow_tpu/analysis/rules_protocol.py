@@ -1,0 +1,555 @@
+"""Wire-protocol & failure-domain rules (ISSUE 18; docs/ANALYSIS.md).
+
+ROADMAP items 2 (pod-scale parameter store) and 5 (persistent binary
+serve transport) put the hand-rolled binary formats — XFS1/XFS2,
+packed-v2, delta chains, checkpoint manifests — onto real sockets
+across failure domains.  Every downstream guarantee (bitwise fan-out
+parity, delta digest chains, rollout atomicity) assumes codecs,
+timeouts, and failpoint coverage don't silently decay; these five
+rules gate that fabric statically, before bytes leave the host:
+
+* XF016 codec parity — every ``struct`` format string packed anywhere
+  in the tree must be unpacked somewhere (and vice versa), and every
+  wire module's format fingerprint (magic constants, format-version
+  constants, struct format strings) must match the committed
+  ``protocol-registry.json``: changing a wire format without a
+  registered version/magic bump is a finding, not a silent drift.
+* XF017 blocking-I/O timeout discipline — ``.result()``/``.wait()``/
+  bare ``.get()`` and HTTP/socket constructors in the serve/stream/
+  store domain must carry a timeout; failures route through
+  ``retry_call``/``emit_health`` (chaos/heal.py) or a typed error.
+  The I/O-domain extension of XF007's no-untimed-blocking-under-lock.
+* XF018 failpoint coverage — file-I/O boundaries in the chaos-covered
+  modules (io/, serve/, stream/, store/, utils/checkpoint.py) must be
+  reachable from a registered ``failpoint(...)`` site, so the fault
+  fabric (PR 11) can't rot as code lands.
+* XF019 determinism taint — wall-clock/random values must not flow
+  into digest computations (hashlib constructors/updates, ``*digest*``
+  helpers): the invariant every bitwise gate stands on.
+* XF020 explicit-endian/width discipline — every ``struct`` format
+  literal must begin with an explicit byte-order prefix (``<``, ``>``
+  or ``!``); native order/size (``@`` or none, and ``=``) describes
+  the host, not the wire.
+
+Runtime companion: analysis/wirefuzz.py (seeded structure-aware
+decoder fuzzer); both halves gate in scripts/check_protocol.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Iterator
+
+from xflow_tpu.analysis.core import (
+    Finding,
+    PackageIndex,
+    Rule,
+    SourceFile,
+    dotted_name,
+    walk_scoped,
+)
+from xflow_tpu.analysis.rules_concurrency import get_context
+
+DEFAULT_REGISTRY = "protocol-registry.json"
+
+PROTOCOL_RULES = ["XF016", "XF017", "XF018", "XF019", "XF020"]
+
+# serve/stream/store: the processes-talking-to-processes domain where
+# an unbounded block turns one slow peer into a wedged tier (XF017)
+_IO_DOMAIN_PREFIXES = ("serve/", "stream/", "store/")
+
+# modules the chaos fabric (chaos/registry.py) must keep covered: the
+# storage/wire boundaries whose faults PR 11's gate injects (XF018)
+_CHAOS_PREFIXES = ("io/", "serve/", "stream/", "store/")
+_CHAOS_FILES = ("utils/checkpoint.py",)
+
+_PACK_LEAVES = ("pack", "pack_into")
+_UNPACK_LEAVES = ("unpack", "unpack_from", "iter_unpack")
+_STRUCT_FMT_LEAVES = _PACK_LEAVES + _UNPACK_LEAVES + ("Struct", "calcsize")
+
+
+def _in_domain(rel: str, prefixes, files=()) -> bool:
+    if rel in files or any(rel.endswith("/" + f) for f in files):
+        return True
+    return any(
+        rel.startswith(p) or ("/" + p) in rel for p in prefixes
+    )
+
+
+def _leaf(node: ast.AST) -> str | None:
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _fmt_literal(call: ast.Call) -> str | None:
+    """The struct format string when the call's first argument is a
+    plain literal (the only statically checkable case)."""
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+        call.args[0].value, str
+    ):
+        return call.args[0].value
+    return None
+
+
+def _timeout_arg(call: ast.Call) -> bool:
+    if call.args:
+        return True
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+# -- per-file wire inventory (XF016 + XF020 share it) ----------------------
+
+
+class _WireUse:
+    """struct format usage + magic/version constants of one file."""
+
+    def __init__(self) -> None:
+        self.packed: dict[str, ast.AST] = {}  # fmt -> first site
+        self.unpacked: dict[str, ast.AST] = {}
+        self.formats: dict[str, ast.AST] = {}  # any struct fmt literal
+        self.magics: dict[str, str] = {}  # NAME -> bytes hex
+        self.versions: dict[str, int] = {}
+        self.const_nodes: dict[str, ast.AST] = {}
+
+
+def _collect_wire(sf: SourceFile) -> _WireUse:
+    use = _WireUse()
+    struct_names: dict[str, str] = {}  # local Struct-object name -> fmt
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            val = node.value
+            if (
+                isinstance(val, ast.Call)
+                and dotted_name(val.func) in ("struct.Struct", "Struct")
+                and _fmt_literal(val) is not None
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        struct_names[tgt.id] = _fmt_literal(val)
+            if isinstance(val, ast.Constant):
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    name = tgt.id
+                    if isinstance(val.value, bytes) and "MAGIC" in name:
+                        use.magics[name] = val.value.hex()
+                        use.const_nodes[name] = node
+                    elif isinstance(val.value, int) and not isinstance(
+                        val.value, bool
+                    ) and (
+                        name.endswith("_FORMAT") or name.endswith("_VERSION")
+                    ):
+                        use.versions[name] = val.value
+                        use.const_nodes[name] = node
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        head, _, leaf = name.rpartition(".")
+        if head == "struct" and leaf in _STRUCT_FMT_LEAVES:
+            fmt = _fmt_literal(node)
+            if fmt is not None:
+                use.formats.setdefault(fmt, node)
+                if leaf in _PACK_LEAVES:
+                    use.packed.setdefault(fmt, node)
+                elif leaf in _UNPACK_LEAVES:
+                    use.unpacked.setdefault(fmt, node)
+        elif head in struct_names and leaf in _PACK_LEAVES + _UNPACK_LEAVES:
+            fmt = struct_names[head]
+            use.formats.setdefault(fmt, node)
+            if leaf in _PACK_LEAVES:
+                use.packed.setdefault(fmt, node)
+            else:
+                use.unpacked.setdefault(fmt, node)
+    return use
+
+
+def wire_fingerprint(sf: SourceFile) -> dict | None:
+    """The registry entry for one file: magic constants, format-version
+    constants, struct format strings.  None when the file touches no
+    wire surface (nothing to register)."""
+    if sf.tree is None:
+        return None
+    use = _collect_wire(sf)
+    if not (use.magics or use.versions or use.formats):
+        return None
+    return {
+        "magics": dict(sorted(use.magics.items())),
+        "versions": dict(sorted(use.versions.items())),
+        "formats": sorted(use.formats),
+    }
+
+
+def find_registry(index: PackageIndex) -> str | None:
+    """protocol-registry.json next to (or one level above) a scan root
+    — repo layout: roots=[REPO/xflow_tpu], registry at REPO/ (the
+    find_budget idiom, rules_memory.py)."""
+    for root in index.roots:
+        for base in (root, os.path.dirname(root)):
+            cand = os.path.join(base, DEFAULT_REGISTRY)
+            if os.path.exists(cand):
+                return cand
+    return None
+
+
+def load_registry(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return doc.get("modules", {})
+
+
+def build_registry(index: PackageIndex) -> dict:
+    """Current-tree fingerprints, ready to commit (check_protocol.py
+    --write-registry)."""
+    modules = {}
+    for sf in index.files:
+        fp = wire_fingerprint(sf)
+        if fp is not None:
+            modules[sf.rel] = fp
+    return modules
+
+
+class CodecParity(Rule):
+    id = "XF016"
+    title = "encoder without decoder / unregistered wire-format change"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        uses: dict[SourceFile, _WireUse] = {}
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            use = _collect_wire(sf)
+            if use.packed or use.unpacked or use.magics or use.versions:
+                uses[sf] = use
+        all_packed = {f for u in uses.values() for f in u.packed}
+        all_unpacked = {f for u in uses.values() for f in u.unpacked}
+        for sf, use in uses.items():
+            for fmt, node in sorted(use.packed.items()):
+                if fmt not in all_unpacked:
+                    yield self.finding(
+                        sf, node,
+                        f"struct format {fmt!r} is packed here but "
+                        "never unpacked anywhere in the scanned tree — "
+                        "a write-only wire format has no decoder to "
+                        "cross-check (codec parity)",
+                    )
+            for fmt, node in sorted(use.unpacked.items()):
+                if fmt not in all_packed:
+                    yield self.finding(
+                        sf, node,
+                        f"struct format {fmt!r} is unpacked here but "
+                        "never packed anywhere in the scanned tree — "
+                        "a read-only wire format has no encoder to "
+                        "cross-check (codec parity)",
+                    )
+        # registry half: wire fingerprints vs the committed registry.
+        # No registry next to the scan roots (unit-test trees) = the
+        # check is not armed, same contract as XF014's memory budget.
+        path = find_registry(index)
+        if path is None:
+            return
+        try:
+            registry = load_registry(path)
+        except (OSError, ValueError) as e:
+            yield Finding(
+                rule=self.id, path=DEFAULT_REGISTRY, line=0,
+                message=f"unreadable protocol registry: {e}",
+            )
+            return
+        current = {sf.rel: wire_fingerprint(sf) for sf in index.files}
+        current = {k: v for k, v in current.items() if v is not None}
+        for rel, fp in sorted(current.items()):
+            want = registry.get(rel)
+            sf = index.by_rel(rel)
+            node = ast.Module(body=[], type_ignores=[])
+            if want is None:
+                yield self.finding(
+                    sf, node,
+                    "wire module is not registered in "
+                    f"{DEFAULT_REGISTRY} — register its magic/version/"
+                    "format fingerprint (python scripts/"
+                    "check_protocol.py --write-registry)",
+                )
+            elif want != fp:
+                drift = [
+                    k for k in ("magics", "versions", "formats")
+                    if want.get(k) != fp.get(k)
+                ]
+                yield self.finding(
+                    sf, node,
+                    f"wire fingerprint drifted from {DEFAULT_REGISTRY} "
+                    f"({', '.join(drift)} changed) — a format change "
+                    "requires a version/magic bump registered via "
+                    "python scripts/check_protocol.py --write-registry",
+                )
+        for rel in sorted(set(registry) - set(current)):
+            yield Finding(
+                rule=self.id, path=rel, line=0,
+                message=f"stale {DEFAULT_REGISTRY} entry: module no "
+                "longer defines a wire surface — prune it "
+                "(python scripts/check_protocol.py --write-registry)",
+            )
+
+
+class BlockingIoTimeout(Rule):
+    id = "XF017"
+    title = "blocking I/O without timeout in the serve/stream/store domain"
+
+    _HTTP_CTORS = (
+        "HTTPConnection", "HTTPSConnection", "create_connection",
+        "urlopen",
+    )
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        for sf in index.files:
+            if sf.tree is None or not _in_domain(
+                sf.rel, _IO_DOMAIN_PREFIXES
+            ):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                leaf = _leaf(node.func)
+                if leaf in ("result", "wait"):
+                    if not _timeout_arg(node):
+                        yield self.finding(
+                            sf, node,
+                            f".{leaf}() without a timeout blocks this "
+                            "domain's thread on a peer that may never "
+                            "answer — pass a timeout and route the "
+                            "failure through retry_call/emit_health "
+                            "(chaos/heal.py) or a typed error",
+                        )
+                elif leaf == "get":
+                    # bare .get(): the blocking-queue idiom (dict.get
+                    # always carries a key argument)
+                    if not node.args and not node.keywords:
+                        yield self.finding(
+                            sf, node,
+                            ".get() with no timeout blocks forever on "
+                            "an empty queue — pass timeout= (or a "
+                            "sentinel-drain justified pragma) and "
+                            "route the failure through retry_call/"
+                            "emit_health or a typed error",
+                        )
+                elif leaf in self._HTTP_CTORS:
+                    if not any(
+                        kw.arg == "timeout" for kw in node.keywords
+                    ):
+                        yield self.finding(
+                            sf, node,
+                            f"{leaf}(...) without timeout= gives the "
+                            "socket no deadline — a wedged peer holds "
+                            "this thread indefinitely; pass an "
+                            "explicit timeout (Config serve_*_timeout_s"
+                            " knobs)",
+                        )
+
+
+class FailpointCoverage(Rule):
+    id = "XF018"
+    title = "I/O boundary unreachable from any registered chaos site"
+
+    _IO_LEAVES = {"open", "replace", "load", "save", "fsync"}
+    _IO_NAMES = {
+        "open", "os.replace", "np.load", "np.save", "numpy.load",
+        "numpy.save", "os.fsync",
+    }
+
+    def _does_io(self, fn) -> ast.AST | None:
+        for node in walk_scoped(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in self._IO_NAMES:
+                return node
+        return None
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        ctx = get_context(index)
+        # seeds: functions that call failpoint(...) directly
+        seeds = set()
+        for fn in ctx.fns:
+            for node in walk_scoped(fn.node):
+                if (
+                    isinstance(node, ast.Call)
+                    and _leaf(node.func) == "failpoint"
+                ):
+                    seeds.add(id(fn))
+                    break
+        # reverse call graph: covered = self or any transitive caller
+        # calls failpoint (the site fires whenever the boundary is on
+        # an injected path)
+        callers: dict[int, list] = {}
+        for fn in ctx.fns:
+            for callee in fn.calls:
+                callers.setdefault(id(callee), []).append(fn)
+        covered: dict[int, bool] = {}
+
+        def is_covered(fn) -> bool:
+            stack, visiting = [fn], set()
+            # iterative DFS up the caller chain with memoization
+            while stack:
+                cur = stack[-1]
+                if id(cur) in covered:
+                    stack.pop()
+                    continue
+                if id(cur) in seeds:
+                    covered[id(cur)] = True
+                    stack.pop()
+                    continue
+                if id(cur) in visiting:
+                    ups = callers.get(id(cur), [])
+                    covered[id(cur)] = any(
+                        covered.get(id(u), False) for u in ups
+                    )
+                    stack.pop()
+                    continue
+                visiting.add(id(cur))
+                for up in callers.get(id(cur), []):
+                    if id(up) not in covered and id(up) not in visiting:
+                        stack.append(up)
+            return covered[id(fn)]
+
+        for fn in ctx.fns:
+            rel = fn.sf.rel
+            if not _in_domain(rel, _CHAOS_PREFIXES, _CHAOS_FILES):
+                continue
+            if rel.endswith("__main__.py") or fn.name == "main":
+                continue  # CLI one-shots are not the fault fabric
+            site = self._does_io(fn)
+            if site is None:
+                continue
+            if not is_covered(fn):
+                yield self.finding(
+                    fn.sf, site,
+                    f"{fn.qualname} performs file I/O but is not "
+                    "reachable from any failpoint(...) chaos site — "
+                    "the fault-injection gate (scripts/check_chaos.py) "
+                    "cannot exercise this boundary; add a failpoint on "
+                    "the path or justify with a pragma "
+                    "(docs/ROBUSTNESS.md)",
+                )
+
+
+class DeterminismTaint(Rule):
+    id = "XF019"
+    title = "wall-clock/random value flowing into a digest"
+
+    _TAINT_NAMES = {
+        "time.time", "time.time_ns", "time.perf_counter",
+        "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+        "os.urandom", "uuid.uuid4", "uuid.uuid1", "random.random",
+        "random.randint", "random.randrange", "random.getrandbits",
+    }
+
+    def _tainted_call(self, node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and dotted_name(node.func) in self._TAINT_NAMES
+        )
+
+    def _expr_tainted(self, node: ast.AST, tainted: set[str]) -> bool:
+        for sub in ast.walk(node):
+            if self._tainted_call(sub):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in tainted:
+                return True
+        return False
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        ctx = get_context(index)
+        for fn in ctx.fns:
+            tainted: set[str] = set()
+            hashes: set[str] = set()
+            # pass 1 to fixed point (walk_scoped has no source-order
+            # guarantee): names assigned from taint sources or hashlib
+            # constructors
+            assigns = [
+                n for n in walk_scoped(fn.node)
+                if isinstance(n, ast.Assign)
+            ]
+            changed = True
+            while changed:
+                changed = False
+                for node in assigns:
+                    names = [
+                        t.id for t in node.targets
+                        if isinstance(t, ast.Name)
+                    ]
+                    if not names:
+                        continue
+                    if self._expr_tainted(node.value, tainted) and not (
+                        set(names) <= tainted
+                    ):
+                        tainted.update(names)
+                        changed = True
+                    if isinstance(node.value, ast.Call):
+                        ctor = dotted_name(node.value.func) or ""
+                        if ctor.startswith("hashlib.") and not (
+                            set(names) <= hashes
+                        ):
+                            hashes.update(names)
+                            changed = True
+            if not tainted and not any(
+                self._tainted_call(n) for n in walk_scoped(fn.node)
+            ):
+                continue
+            # pass 2: taint reaching a digest sink
+            for node in walk_scoped(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                leaf = name.rsplit(".", 1)[-1]
+                is_sink = (
+                    name.startswith("hashlib.")
+                    or "digest" in leaf
+                    or (
+                        leaf == "update"
+                        and name.rsplit(".", 1)[0] in hashes
+                    )
+                )
+                if not is_sink:
+                    continue
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if self._expr_tainted(arg, tainted):
+                        yield self.finding(
+                            fn.sf, node,
+                            f"{fn.qualname} feeds a wall-clock/random "
+                            f"value into {name or leaf}(...) — digests "
+                            "must be deterministic functions of config "
+                            "+ data or every bitwise gate (fan-out "
+                            "parity, delta chains, rollout identity) "
+                            "silently breaks",
+                        )
+                        break
+
+
+class ExplicitEndian(Rule):
+    id = "XF020"
+    title = "native-order struct format on a cross-process surface"
+
+    def run(self, index: PackageIndex) -> Iterator[Finding]:
+        for sf in index.files:
+            if sf.tree is None:
+                continue
+            use = _collect_wire(sf)
+            for fmt, node in sorted(use.formats.items()):
+                if not fmt or fmt[0] not in "<>!":
+                    how = (
+                        "'=' (native byte order)" if fmt[:1] == "="
+                        else "native order AND native sizes"
+                    )
+                    yield self.finding(
+                        sf, node,
+                        f"struct format {fmt!r} uses {how} — bytes "
+                        "that cross a process/host boundary must pin "
+                        "byte order and width explicitly ('<', '>' or "
+                        "'!'), or a mixed-arch pod reads garbage",
+                    )

@@ -1,0 +1,478 @@
+"""Seeded structure-aware decoder fuzzer — the runtime half of the
+wire-protocol gate (ISSUE 18; static half: rules_protocol.py, both
+gated by scripts/check_protocol.py).
+
+Every hand-rolled binary format in the tree gets its decoder driven
+through hundreds of deterministic mutations of a VALID blob:
+
+* ``xfs1`` / ``xfs2``  — the packed HTTP scoring request
+  (serve/server.py; XFS2 = traced variant), with a decode→re-encode
+  roundtrip check: an accepted mutant must re-encode byte-exactly
+  (the format is canonical), or the decoder silently rewrote the
+  payload;
+* ``packed_v2``        — the device-ready CompactBatch shard
+  (io/packed.py, driven through the buffered BytesIO reader path);
+* ``binary_csr``       — the XFBC0001 CSR block cache (io/binary.py);
+* ``delta_manifest``   — the incremental-export manifest + its
+  digest-chain refusal ladder (stream/delta.py).
+
+Mutations are structure-aware: truncation, magic confusion (overlay
+another format's magic), length/count inflation (overwrite an aligned
+little-endian window with huge values), field transposition (swap two
+windows), byte flips, zero-fill.  The contract under fuzz: a decoder
+either ACCEPTS a structurally valid payload or raises a TYPED error
+(ValueError — incl. JSONDecodeError/UnicodeDecodeError — KeyError, or
+struct.error, the taxonomy serve/server.py maps to HTTP 400).  Any
+other exception, a hang, or an accepted-but-rewritten payload is a
+gate failure.
+
+Determinism: all randomness comes from a splitmix64 stream seeded by
+the caller (the chaos/registry.py mixer idiom) — same seed, same
+mutations, same report digest.  tests/test_analysis.py pins this.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import shutil
+import struct
+import tempfile
+import time
+from typing import Callable
+
+import numpy as np
+
+# deliberate refusals — the exception taxonomy the serve handler maps
+# to HTTP 400 (serve/server.py _do_post) and the loaders treat as
+# "corrupt shard".  Everything else escaping a decoder is a bug.
+TYPED_ERRORS = (ValueError, KeyError, struct.error)
+
+# one fuzz case may not take longer than this (a "fast refusal" that
+# scans gigabytes first is a DoS on the serve path)
+CASE_BUDGET_S = 5.0
+
+DEFAULT_SEED = 0xC0FFEE
+DEFAULT_ROUNDS = 200
+
+
+class SplitMix64:
+    """Deterministic 64-bit stream (same mixer as chaos/registry.py)."""
+
+    _MASK = (1 << 64) - 1
+
+    def __init__(self, seed: int):
+        self._s = seed & self._MASK
+
+    def next(self) -> int:
+        self._s = (self._s + 0x9E3779B97F4A7C15) & self._MASK
+        z = self._s
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & self._MASK
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & self._MASK
+        return z ^ (z >> 31)
+
+    def randrange(self, n: int) -> int:
+        """Uniform-ish in [0, n) — modulo bias is irrelevant for
+        mutation placement."""
+        if n <= 0:
+            return 0
+        return self.next() % n
+
+    def choice(self, seq):
+        return seq[self.randrange(len(seq))]
+
+
+# -- mutators ---------------------------------------------------------------
+
+
+def _mut_truncate(rng: SplitMix64, blob: bytes, magics) -> bytes:
+    return blob[: rng.randrange(len(blob))]
+
+
+def _mut_flip(rng: SplitMix64, blob: bytes, magics) -> bytes:
+    i = rng.randrange(len(blob))
+    b = bytearray(blob)
+    b[i] ^= 1 + rng.randrange(255)
+    return bytes(b)
+
+
+def _mut_magic(rng: SplitMix64, blob: bytes, magics) -> bytes:
+    other = rng.choice(magics)
+    return other + blob[len(other):]
+
+
+def _mut_inflate(rng: SplitMix64, blob: bytes, magics) -> bytes:
+    """Overwrite an aligned window with a huge little-endian value —
+    the count/length-inflation attack (nrows, nnz, rec_bytes, hlen)."""
+    width = rng.choice((2, 4, 8))
+    if len(blob) <= width:
+        return blob + b"\xff" * width
+    off = rng.randrange(len(blob) - width)
+    big = (1 << (8 * width)) - 1 - rng.randrange(1 << (4 * width))
+    b = bytearray(blob)
+    b[off : off + width] = big.to_bytes(width, "little")
+    return bytes(b)
+
+
+def _mut_transpose(rng: SplitMix64, blob: bytes, magics) -> bytes:
+    """Swap two equal-size windows — field transposition."""
+    if len(blob) < 8:
+        return blob[::-1]
+    w = 1 + rng.randrange(min(16, len(blob) // 2))
+    i = rng.randrange(len(blob) - w)
+    j = rng.randrange(len(blob) - w)
+    if i > j:
+        i, j = j, i
+    if j < i + w:  # overlap: degrade to a reversal of one window
+        b = bytearray(blob)
+        b[i : i + w] = b[i : i + w][::-1]
+        return bytes(b)
+    b = bytearray(blob)
+    b[i : i + w], b[j : j + w] = b[j : j + w], b[i : i + w]
+    return bytes(b)
+
+
+def _mut_zero(rng: SplitMix64, blob: bytes, magics) -> bytes:
+    w = 1 + rng.randrange(min(32, len(blob)))
+    off = rng.randrange(max(1, len(blob) - w))
+    b = bytearray(blob)
+    b[off : off + w] = b"\x00" * w
+    return bytes(b)
+
+
+_MUTATORS = (
+    _mut_truncate,
+    _mut_flip,
+    _mut_magic,
+    _mut_inflate,
+    _mut_transpose,
+    _mut_zero,
+)
+
+
+# -- targets ----------------------------------------------------------------
+
+
+class FuzzTarget:
+    def __init__(
+        self,
+        name: str,
+        blob: bytes,
+        decode: Callable[[bytes], object],
+        reencode: Callable[[bytes], bytes] | None = None,
+    ):
+        self.name = name
+        self.blob = blob
+        self.decode = decode
+        # reencode: blob -> canonical re-encoding of decode(blob); an
+        # accepted mutant whose re-encoding differs was silently
+        # rewritten by the decoder (the "silently-wrong rows" failure)
+        self.reencode = reencode
+
+
+def _xfs_rows() -> list:
+    """A small deterministic request in the featurize_raw row
+    protocol: a full (keys, slots, vals) row, a slots-only row, and a
+    bare key array."""
+    return [
+        (
+            (np.arange(5, dtype=np.int64) * 1000003 + 7),
+            np.arange(5, dtype=np.int32),
+            np.linspace(0.125, 1.0, 5).astype(np.float32),
+        ),
+        (np.asarray([3, 9], np.int64), np.asarray([0, 1], np.int32), None),
+        np.asarray([42], np.int64),
+    ]
+
+
+def _make_xfs_targets() -> list[FuzzTarget]:
+    from xflow_tpu.obs.reqtrace import TraceContext
+    from xflow_tpu.serve.server import (
+        decode_packed_request_traced,
+        encode_packed_request,
+    )
+
+    def reencode(blob: bytes) -> bytes:
+        rows, trace = decode_packed_request_traced(blob)
+        return encode_packed_request(rows, trace)
+
+    def decode(blob: bytes):
+        return decode_packed_request_traced(blob)
+
+    plain = encode_packed_request(_xfs_rows())
+    traced = encode_packed_request(
+        _xfs_rows(),
+        trace=TraceContext(0x1234_5678_9ABC_DEF0, 17, True),
+    )
+    return [
+        FuzzTarget("xfs1", plain, decode, reencode),
+        FuzzTarget("xfs2", traced, decode, reencode),
+    ]
+
+
+def _make_packed_v2_target(workdir: str) -> FuzzTarget:
+    from xflow_tpu.io import packed
+    from xflow_tpu.io.batch import make_batch
+
+    b_sz, k, table = 8, 6, 1 << 14
+    keys = (
+        np.arange(b_sz * k, dtype=np.int64).reshape(b_sz, k) * 2654435761
+    ) % table
+    slots = np.tile(np.arange(k, dtype=np.int32), (b_sz, 1))
+    vals = np.ones((b_sz, k), np.float32)
+    mask = np.ones((b_sz, k), np.float32)
+    mask[:, k - 1] = 0.0  # a padded tail entry per row
+    labels = (np.arange(b_sz) % 2).astype(np.float32)
+    weights = np.ones(b_sz, np.float32)
+    batch = make_batch(keys, slots, vals, mask, labels, weights)
+    meta = dict(
+        batch_size=b_sz, cold_nnz=k, hot_nnz=0, hot_size=0,
+        table_size=table, hash_mode=True, hash_seed=0,
+        remap_sha256=None,
+    )
+    path = os.path.join(workdir, "fuzz-shard.pk2")
+    packed.write_shard_v2(path, meta, iter([batch, batch]))
+    with open(path, "rb") as f:
+        blob = f.read()
+
+    def decode(mutant: bytes):
+        # BytesIO: no usable fileno, so the reader takes the buffered
+        # fallback — same plane math as the mmap path (pinned byte-
+        # equal by tests/test_compact.py)
+        out = []
+        for cb, _, _ in packed.iter_compact_batches(io.BytesIO(mutant)):
+            out.append(cb)
+        return out
+
+    return FuzzTarget("packed_v2", blob, decode)
+
+
+def _make_binary_csr_target() -> FuzzTarget:
+    from xflow_tpu.io import binary, container
+    from xflow_tpu.io.batch import ParsedBlock
+
+    buf = io.BytesIO()
+    meta = {"version": 1, "hash_mode": True, "hash_seed": 0}
+    hdr_len = container.write_placeholder_header(
+        buf, binary.MAGIC, meta, ("examples", "nnz", "blocks")
+    )
+    block = ParsedBlock(
+        labels=np.asarray([1.0, 0.0], np.float32),
+        row_ptr=np.asarray([0, 2, 3], np.int64),
+        keys=np.asarray([11, -5, 1 << 40], np.int64),
+        slots=np.asarray([0, 1, 0], np.int32),
+        vals=np.asarray([1.0, 0.5, 2.0], np.float32),
+    )
+    binary._write_record(buf, block)
+    meta.update(examples=2, nnz=3, blocks=1)
+    container.rewrite_header(buf, binary.MAGIC, meta, hdr_len)
+
+    def decode(mutant: bytes):
+        out = []
+        for blk, _, _ in binary.iter_blocks(io.BytesIO(mutant), 1 << 14):
+            out.append(blk)
+        return out
+
+    return FuzzTarget("binary_csr", buf.getvalue(), decode)
+
+
+def _make_delta_target(workdir: str) -> FuzzTarget:
+    from xflow_tpu.config import Config
+    from xflow_tpu.serve.artifact import servable_digest
+    from xflow_tpu.stream.delta import (
+        DELTA_FORMAT,
+        DELTA_MANIFEST,
+        load_delta_manifest,
+    )
+
+    cfg = Config()
+    digest = cfg.digest()
+    manifest = {
+        "format": DELTA_FORMAT,
+        "kind": "delta",
+        "model": cfg.model,
+        "config": cfg.to_json(),
+        "config_digest": digest,
+        "step": 100,
+        "base_step": 50,
+        "base_digest": servable_digest(digest, 50),
+        "delta_digest": servable_digest(digest, 100),
+        "rows": 0,
+        "arrays": {},
+        "dense": [],
+        "content_sha256": "0" * 64,
+        "created_unix": 0.0,
+    }
+    blob = json.dumps(manifest, indent=2).encode()
+    ddir = os.path.join(workdir, "fuzz-delta")
+    os.makedirs(ddir, exist_ok=True)
+
+    def decode(mutant: bytes):
+        with open(os.path.join(ddir, DELTA_MANIFEST), "wb") as f:
+            f.write(mutant)
+        return load_delta_manifest(ddir)
+
+    return FuzzTarget("delta_manifest", blob, decode)
+
+
+def build_targets(workdir: str) -> list[FuzzTarget]:
+    """One FuzzTarget per wire decoder, each seeded with a valid blob."""
+    return [
+        *_make_xfs_targets(),
+        _make_packed_v2_target(workdir),
+        _make_binary_csr_target(),
+        _make_delta_target(workdir),
+    ]
+
+
+# -- driver -----------------------------------------------------------------
+
+
+def fuzz_target(
+    target: FuzzTarget,
+    rng: SplitMix64,
+    rounds: int,
+    sha: "hashlib._Hash | None" = None,
+) -> dict:
+    """Drive one decoder through ``rounds`` mutations; returns the
+    per-target report.  ``sha`` (when given) absorbs every mutant for
+    the run-level determinism digest."""
+    from xflow_tpu.io import binary, packed
+    from xflow_tpu.serve.server import PACKED_MAGIC, PACKED_TRACE_MAGIC
+
+    magics = [PACKED_MAGIC, PACKED_TRACE_MAGIC, binary.MAGIC, packed.MAGIC]
+    magics = [m for m in magics if not target.blob.startswith(m)]
+    # the pristine blob must decode — a broken builder would make every
+    # "typed error" below meaningless
+    target.decode(target.blob)
+    if target.reencode is not None and target.reencode(
+        target.blob
+    ) != target.blob:
+        raise AssertionError(
+            f"{target.name}: valid blob does not round-trip — builder "
+            "or codec bug, fuzz results would be meaningless"
+        )
+    counts = {
+        "typed": 0, "accepted": 0, "accepted_mismatch": 0,
+        "untyped": 0, "slow": 0,
+    }
+    failures: list[dict] = []
+    for case in range(rounds):
+        mutator = _MUTATORS[rng.randrange(len(_MUTATORS))]
+        mutant = mutator(rng, target.blob, magics)
+        if sha is not None:
+            sha.update(target.name.encode())
+            sha.update(case.to_bytes(4, "little"))
+            sha.update(mutant)
+        t0 = time.perf_counter()
+        outcome, detail = _drive(target, mutant)
+        elapsed = time.perf_counter() - t0
+        if elapsed > CASE_BUDGET_S:
+            outcome, detail = "slow", f"case took {elapsed:.1f}s"
+        counts[outcome] += 1
+        if outcome in ("untyped", "accepted_mismatch", "slow") and len(
+            failures
+        ) < 8:
+            failures.append({
+                "case": case,
+                "mutator": mutator.__name__,
+                "outcome": outcome,
+                "detail": detail,
+            })
+    return {
+        "rounds": rounds,
+        "counts": counts,
+        "failures": failures,
+        "ok": not (
+            counts["untyped"] or counts["accepted_mismatch"]
+            or counts["slow"]
+        ),
+    }
+
+
+def _drive(target: FuzzTarget, mutant: bytes) -> tuple[str, str]:
+    try:
+        target.decode(mutant)
+    except TYPED_ERRORS as e:
+        return "typed", type(e).__name__
+    except Exception as e:  # the gate failure we exist to catch
+        return "untyped", f"{type(e).__name__}: {e}"
+    if mutant == target.blob:
+        return "accepted", "mutation was identity"
+    if target.reencode is not None:
+        try:
+            if target.reencode(mutant) != mutant:
+                return (
+                    "accepted_mismatch",
+                    "decoder accepted a mutant that does not re-encode "
+                    "byte-exactly — silently rewritten payload",
+                )
+        except TYPED_ERRORS:
+            return (
+                "accepted_mismatch",
+                "mutant decoded but its decoded form refuses to "
+                "re-encode — decoder accepted out-of-domain values",
+            )
+    return "accepted", "structurally valid mutation"
+
+
+def run_wirefuzz(
+    seed: int = DEFAULT_SEED,
+    rounds: int = DEFAULT_ROUNDS,
+    workdir: str | None = None,
+) -> dict:
+    """Fuzz every wire decoder; returns the run report.
+
+    ``mutation_digest`` is a sha256 over (target, case, mutant bytes)
+    for the whole run — byte-identical across runs with the same seed
+    and rounds (the determinism contract tests/test_analysis.py pins).
+    """
+    own_dir = workdir is None
+    if own_dir:
+        workdir = tempfile.mkdtemp(prefix="xf-wirefuzz-")
+    try:
+        sha = hashlib.sha256()
+        targets = build_targets(workdir)
+        report: dict = {
+            "seed": seed,
+            "rounds": rounds,
+            "targets": {},
+        }
+        for i, target in enumerate(targets):
+            # per-target stream: target order can change without
+            # re-rolling every other target's mutations
+            rng = SplitMix64((seed ^ (0xA5A5_0000 + i)) * 0x9E3779B9)
+            report["targets"][target.name] = fuzz_target(
+                target, rng, rounds, sha
+            )
+        report["mutation_digest"] = sha.hexdigest()
+        report["ok"] = all(
+            t["ok"] for t in report["targets"].values()
+        )
+        return report
+    finally:
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def render_report(report: dict) -> str:
+    lines = [
+        f"wirefuzz: seed={report['seed']:#x} rounds={report['rounds']} "
+        f"digest={report['mutation_digest'][:16]}",
+    ]
+    for name, t in report["targets"].items():
+        c = t["counts"]
+        lines.append(
+            f"  {name:<16} typed={c['typed']:<4} "
+            f"accepted={c['accepted']:<4} "
+            f"untyped={c['untyped']} mismatch={c['accepted_mismatch']} "
+            f"slow={c['slow']}  -> {'OK' if t['ok'] else 'FAIL'}"
+        )
+        for f in t["failures"]:
+            lines.append(
+                f"    case {f['case']} [{f['mutator']}] "
+                f"{f['outcome']}: {f['detail']}"
+            )
+    return "\n".join(lines)

@@ -203,6 +203,21 @@ class Config:
     # survivable, a corrupt stream is not trainable.
     max_quarantined_frac: float = 0.05
 
+    # -- serve tier timeout discipline (serve/server.py; analysis rule
+    # XF017: no blocking wait in the serve path may be unbounded) --
+    # How long a request handler waits on its scoring futures before
+    # answering 504 (admitted-but-slow is a gateway timeout, not a
+    # server bug — serve/server.py::_do_post).
+    serve_score_timeout_s: float = 60.0
+    # Per-connection socket timeout on handler reads/writes: a client
+    # that stops mid-request (half-open TCP, stalled upload) releases
+    # its handler thread after this long instead of pinning it forever.
+    serve_socket_timeout_s: float = 30.0
+    # Client-side HTTP timeout for the loadgen's remote mode
+    # (serve/loadgen.py::HttpTarget → http.client.HTTPConnection
+    # timeout=): bounds connect + each socket op against a wedged tier.
+    serve_client_timeout_s: float = 30.0
+
     # -- host data path --
     # Use the native C++ parser (xflow_tpu/native) when a toolchain is
     # available; falls back to the pure-Python parser silently.
@@ -573,6 +588,16 @@ class Config:
             raise ValueError("io_retry_backoff_s must be >= 0")
         if not 0.0 <= self.max_quarantined_frac <= 1.0:
             raise ValueError("max_quarantined_frac must be in [0, 1]")
+        for knob in (
+            "serve_score_timeout_s",
+            "serve_socket_timeout_s",
+            "serve_client_timeout_s",
+        ):
+            if getattr(self, knob) <= 0:
+                raise ValueError(
+                    f"{knob} must be > 0 (an unbounded serve-path wait "
+                    "is exactly what analysis rule XF017 forbids)"
+                )
         if self.checkpoint_keep < 0:
             raise ValueError("checkpoint_keep must be >= 0")
         if self.transfer_ahead_depth < 1:

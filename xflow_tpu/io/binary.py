@@ -51,6 +51,11 @@ from xflow_tpu.io.batch import ParsedBlock
 
 MAGIC = b"XFBC0001"
 _REC_HDR = struct.Struct("<QQ")  # n_rows, nnz
+# sanity ceiling on record header counts: a u64 count near 2^64 (bit
+# rot / inflation attack) would push read sizes past sys.maxsize and
+# crash with an untyped OverflowError instead of a typed refusal
+# (found by analysis/wirefuzz.py)
+_MAX_REC_COUNT = 1 << 48
 
 
 def is_binary_shard(path: str) -> bool:
@@ -98,6 +103,11 @@ def read_record(f: BinaryIO) -> ParsedBlock | None:
     if len(hdr) != _REC_HDR.size:
         raise ValueError("truncated binary shard record header")
     n, nnz = _REC_HDR.unpack(hdr)
+    if n > _MAX_REC_COUNT or nnz > _MAX_REC_COUNT:
+        raise ValueError(
+            f"binary shard record header counts out of range "
+            f"(n_rows={n} nnz={nnz}) — corrupt record"
+        )
     labels = np.frombuffer(_read_exact(f, 4 * n), np.float32)
     row_ptr = np.frombuffer(_read_exact(f, 8 * (n + 1)), np.int64)
     keys = np.frombuffer(_read_exact(f, 8 * nnz), np.int64)
@@ -177,6 +187,8 @@ def iter_blocks(
 
 
 def shard_example_count(path: str) -> int:
+    # metadata peek (header totals), not a streamed I/O boundary — the
+    # record walk carries loader.read_block (xf: ignore[XF018])
     with open(path, "rb") as f:
         meta, _ = read_header(f)
         return int(meta["examples"])
@@ -226,6 +238,8 @@ def convert_shard(
                 blocks += 1
             meta.update(examples=examples, nnz=nnz, blocks=blocks)
             container.rewrite_header(fout, MAGIC, meta, hdr_len)
+        # offline conversion tool (CLI one-shot, atomic tmp+rename), not
+        # the serving/training fault fabric (xf: ignore[XF018])
         os.replace(tmp, dst)
     finally:
         if os.path.exists(tmp):

@@ -278,6 +278,9 @@ class ShardLoader:
         """
         from xflow_tpu.io import binary, packed
 
+        # chaos site: shard open/sniff fault — distinct from the
+        # per-record sites so open-time failures are injectable (XF018)
+        failpoint("loader.open_shard")
         with open(self.path, "rb") as f:
             magic = f.read(len(binary.MAGIC))
             if magic == binary.MAGIC:
@@ -449,6 +452,8 @@ class ShardLoader:
         if packed.is_packed_shard(self.path):
             return packed.shard_example_count(self.path)
         n = 0
+        # metadata sizing pass for planners, not the streamed training
+        # path — the read path carries loader.* sites (xf: ignore[XF018])
         with open(self.path, "rb") as f:
             for line in f:
                 if line.strip():

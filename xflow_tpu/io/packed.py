@@ -56,6 +56,7 @@ from typing import BinaryIO, Iterator
 
 import numpy as np
 
+from xflow_tpu.chaos import failpoint
 from xflow_tpu.io import container
 from xflow_tpu.io.batch import Batch
 
@@ -154,6 +155,9 @@ def write_shard(
     ``meta`` must hold the config keys of check_compat; totals are
     filled in here."""
     fields, _ = _layout(meta)
+    # chaos site: a transient writer fault mid-shard — the tmp+fsync+
+    # os.replace tail-safety below is what it exercises (XF018)
+    failpoint("packed.write")
     tmp = f"{dst}.tmp.{os.getpid()}"
     os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
     n_batches = 0
@@ -196,6 +200,7 @@ def write_shard_v2(
     and totals are filled in here."""
     from xflow_tpu.io import compact as C
 
+    failpoint("packed.write")
     tmp = f"{dst}.tmp.{os.getpid()}"
     os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
     key_bytes = 3 if meta["table_size"] <= 1 << 24 else 4
@@ -282,6 +287,30 @@ def _iter_records_v2(f: BinaryIO, meta: dict, start_offset: int):
 
     f.seek(0)
     _, data_start = read_header(f)
+    # schema-check the JSON meta BEFORE any arithmetic consumes it: a
+    # corrupt header (fuzzed/bit-rotted JSON values of the wrong type)
+    # must be a typed refusal, not a TypeError deep in plane sizing
+    try:
+        b = int(meta["batch_size"])
+        kc = int(meta["cold_nnz"])
+        kh = int(meta["hot_nnz"])
+        dict_cap = int(meta["dict_cap"])
+        key_bytes = int(meta["key_bytes"])
+        hx16 = bool(meta["hx16"])
+        gdiv = int(meta["granule_div"])
+        gmin = int(meta["granule_min"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(
+            f"packed shard header meta malformed: {e!r}"
+        ) from e
+    if b <= 0 or kc < 0 or kh < 0 or dict_cap < 0 or gdiv <= 0 \
+            or gmin < 0 or key_bytes not in (3, 4):
+        raise ValueError(
+            "packed shard header meta out of range "
+            f"(batch_size={b} cold_nnz={kc} hot_nnz={kh} "
+            f"dict_cap={dict_cap} key_bytes={key_bytes} "
+            f"granule_div={gdiv} granule_min={gmin})"
+        )
     try:
         mm: memoryview | bytes | mmap.mmap = mmap.mmap(
             f.fileno(), 0, access=mmap.ACCESS_READ
@@ -318,21 +347,43 @@ def _iter_records_v2(f: BinaryIO, meta: dict, start_offset: int):
                     f"start_offset {start_offset} is not a record "
                     "boundary"
                 )
+            # range-check every header count against the shard meta
+            # BEFORE sizing planes: a corrupt/adversarial header must
+            # raise here, not address planes out of bounds or hand the
+            # model a silently-wrong batch (wirefuzz pins this)
+            ok = (
+                0 <= n_real <= b
+                and 0 <= n_cold <= b * kc
+                and 0 <= n_dict_occ <= n_cold
+                and 0 <= n_dict <= n_dict_occ
+                and n_dict <= dict_cap
+                and 0 <= n_hot <= b * kh
+                and 0 <= n_h8 <= n_hot
+                and 0 <= slots_code < len(C._SLOT_DTYPES)
+            )
+            if not ok:
+                raise ValueError(
+                    "packed shard record header counts out of range "
+                    f"(n_real={n_real} n_cold={n_cold} n_dict={n_dict} "
+                    f"n_dict_occ={n_dict_occ} n_hot={n_hot} n_h8={n_h8} "
+                    f"slots_code={slots_code} vs batch_size={b} "
+                    f"cold_nnz={kc} hot_nnz={kh}) — corrupt record"
+                )
             counts = {
                 "n_real": n_real, "n_cold": n_cold, "n_dict": n_dict,
                 "n_dict_occ": n_dict_occ, "n_hot": n_hot,
                 "n_h8": n_h8, "slots_code": slots_code,
             }
             specs = C.plane_specs(
-                batch_size=meta["batch_size"],
-                cold_nnz=meta["cold_nnz"],
-                hot_nnz_cap=meta["hot_nnz"],
-                key_bytes=meta["key_bytes"],
-                hx16=meta["hx16"],
+                batch_size=b,
+                cold_nnz=kc,
+                hot_nnz_cap=kh,
+                key_bytes=key_bytes,
+                hx16=hx16,
                 slots_code=slots_code,
-                dict_cap=meta["dict_cap"],
-                granule_div=meta["granule_div"],
-                granule_min=meta["granule_min"],
+                dict_cap=dict_cap,
+                granule_div=gdiv,
+                granule_min=gmin,
                 **{k: counts[k] for k in (
                     "n_cold", "n_dict", "n_dict_occ", "n_hot", "n_h8"
                 )},
@@ -447,6 +498,8 @@ def iter_batches(
 
 
 def shard_example_count(path: str) -> int:
+    # metadata peek (header totals), not a streamed I/O boundary — the
+    # record-walk readers carry the loader.* sites (xf: ignore[XF018])
     with open(path, "rb") as f:
         meta, _ = read_header(f)
         return int(meta["examples"])
@@ -471,6 +524,7 @@ def split_shard_v2(
 
     if num_shards < 1:
         raise ValueError("num_shards must be >= 1")
+    failpoint("packed.write")
     with open(src, "rb") as f:
         meta, data_start = read_header(f)
         if meta.get("version", 1) != 2:

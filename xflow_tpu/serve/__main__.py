@@ -152,7 +152,7 @@ def cmd_bench(args) -> int:
         for row in my_rows:
             t0 = time.perf_counter()
             fut = batcher.submit(*row)
-            fut.result()
+            fut.result(timeout=600.0)
             dt = time.perf_counter() - t0
             with e2e_lock:
                 e2e.append(dt)
@@ -271,6 +271,8 @@ def cmd_serve(args) -> int:
         port=args.port,
         flight=flight,
         default_canary_frac=args.canary_frac,
+        score_timeout_s=fleet.cfg.serve_score_timeout_s,
+        socket_timeout_s=fleet.cfg.serve_socket_timeout_s,
     )
     wd = Watchdog(
         flight, serve_s=args.watchdog_serve_s, metrics_logger=logger
@@ -410,6 +412,8 @@ def cmd_cascade(args) -> int:
         port=args.port,
         default_canary_frac=args.canary_frac,
         cascade=cascade,
+        score_timeout_s=ranking.cfg.serve_score_timeout_s,
+        socket_timeout_s=ranking.cfg.serve_socket_timeout_s,
     )
     stop = threading.Event()
 
@@ -451,8 +455,11 @@ def cmd_loadgen(args) -> int:
         manifest = load_manifest(args.artifact)
         digest = manifest["config_digest"]
         model = manifest["model"]
-        table_size = int(Config.from_json(manifest["config"]).table_size)
-        target: object = HttpTarget(args.url)
+        cfg = Config.from_json(manifest["config"])
+        table_size = int(cfg.table_size)
+        target: object = HttpTarget(
+            args.url, timeout_s=cfg.serve_client_timeout_s
+        )
         fleet = None
     else:
         from xflow_tpu.serve.fleet import ReplicaFleet

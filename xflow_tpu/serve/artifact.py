@@ -39,6 +39,7 @@ import numpy as np
 
 import jax
 
+from xflow_tpu.chaos import failpoint
 from xflow_tpu.utils.checkpoint import all_ok, iter_owned_shards
 
 MANIFEST = "manifest.json"
@@ -81,6 +82,9 @@ def export_artifact(trainer, directory: str) -> str:
     # book the export's device fetches as an obs phase so a slow export
     # shows up in phase accounting instead of vanishing (XF002)
     obs = getattr(trainer, "obs", None) or NULL_OBS
+    # chaos site: a fault anywhere in the export — the all_ok voting +
+    # tmp-dir/rename-aside recovery below is what it exercises (XF018)
+    failpoint("artifact.export")
     with obs.phase("export_fetch"):
         step = int(jax.device_get(state["step"]))
     proc = jax.process_index()
@@ -260,6 +264,7 @@ def export_item_index(
     Meta (``item_index.json``) carries count/dim/config digest and the
     servable step, so a stale index against a re-exported artifact is
     refused at load."""
+    failpoint("artifact.export")
     manifest = load_manifest(directory)
     if engine.digest != manifest["config_digest"]:
         raise ValueError(
@@ -327,6 +332,7 @@ def load_item_index(directory: str) -> dict | None:
     config digest does not match the artifact manifest — that is a
     stale index left behind by a re-export under a different config,
     and serving it would retrieve with the wrong geometry."""
+    failpoint("artifact.load")
     path = os.path.join(directory, ITEM_INDEX_META)
     if not os.path.exists(path):
         return None
@@ -362,6 +368,7 @@ def load_manifest(directory: str) -> dict:
     digest-scheme drift — either way the artifact identity is void)."""
     from xflow_tpu.config import Config
 
+    failpoint("artifact.load")
     path = os.path.join(directory, MANIFEST)
     if not os.path.exists(path):
         raise ValueError(f"{directory}: no artifact manifest ({MANIFEST})")

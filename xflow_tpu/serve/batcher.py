@@ -301,6 +301,9 @@ class MicroBatcher:
                 # the assert below instead)
                 self._drained.set()
         else:
+            # bounded by construction: the FIRST closer sets _drained in
+            # a finally even when draining raises, and its own joins are
+            # join_timeout-bounded (xf: ignore[XF017])
             self._drained.wait()
         assert self._final_stats is not None
         return self._final_stats
@@ -316,6 +319,9 @@ class MicroBatcher:
     def _loop(self) -> None:
         stopping = False
         while not stopping:
+            # sentinel-drain worker loop: close() always enqueues _STOP
+            # (XF006-gated lifecycle), so the dequeue is never abandoned
+            # (xf: ignore[XF017])
             item = self._q.get()
             if item is _STOP:
                 return

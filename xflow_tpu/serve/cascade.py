@@ -192,7 +192,9 @@ class CascadeEngine:
         # the index rides the result (serve/batcher.py): candidate ids
         # resolve against the EXACT index that produced them — during
         # a retrieval canary, replicas serve different indexes, so
-        # reading "the fleet's" index here would mismatch
+        # reading "the fleet's" index here would mismatch.  rfut is
+        # already resolved: this runs in its done-callback after the
+        # .exception() check above (xf: ignore[XF017])
         ids, scores, index = rfut.result()
         ids, scores = ids[:k], scores[:k]
         by_id = index["item_ids"]
@@ -253,6 +255,8 @@ class CascadeEngine:
                 if resolve_once():
                     self._fail(out, rerr)
                 return
+            # fut is already resolved: done-callback after the
+            # .exception() check above (xf: ignore[XF017])
             pctr[i] = fut.result()
             with rlock:
                 remaining[0] -= 1

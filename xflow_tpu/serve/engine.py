@@ -38,6 +38,7 @@ import numpy as np
 
 import jax
 
+from xflow_tpu.chaos import failpoint
 from xflow_tpu.config import Config
 from xflow_tpu.io.batch import Batch, pad_batch_rows, remap_batch
 from xflow_tpu.obs import NULL_OBS
@@ -252,6 +253,9 @@ class PredictEngine:
         )
         from xflow_tpu.utils.checkpoint import RangeReader
 
+        # chaos site: artifact-load fault — the manifest/digest refusal
+        # chain below is what it exercises (XF018)
+        failpoint("artifact.load")
         manifest = load_manifest(directory)
         cfg = Config.from_json(manifest["config"])
         digest = manifest["config_digest"]

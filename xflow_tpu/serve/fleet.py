@@ -973,6 +973,9 @@ class ReplicaFleet:
                 # set even on failure so concurrent closers never hang
                 self._drained.set()
         else:
+            # bounded by construction: the FIRST closer sets _drained in
+            # a finally even when drain raises, and its joins are
+            # timeout-bounded (xf: ignore[XF017])
             self._drained.wait()
         with self._lock:
             return self._final_rows

@@ -71,7 +71,13 @@ PACKED_MAGIC = b"XFS1"
 # parent_span_id + u8 sampled, then the XFS1 body from nrows on
 PACKED_TRACE_MAGIC = b"XFS2"
 # how long a handler waits on its scoring futures before 504
+# (ServeTier default; Config.serve_score_timeout_s overrides per tier)
 SCORE_TIMEOUT_S = 60.0
+# per-connection socket timeout on handler reads/writes (ServeTier
+# default; Config.serve_socket_timeout_s overrides per tier): a client
+# stalled mid-request releases its handler thread instead of pinning
+# it forever (analysis rule XF017)
+SOCKET_TIMEOUT_S = 30.0
 
 
 # -- packed wire --------------------------------------------------------------
@@ -243,6 +249,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- plumbing -----------------------------------------------------------
 
+    def setup(self) -> None:
+        # BaseHTTPRequestHandler's `timeout` class attribute is None,
+        # so a client that stalls mid-request (half-open TCP, paused
+        # upload) would pin this handler thread indefinitely; a timed-
+        # out read surfaces as ConnectionError/OSError in _do_post's
+        # client-went-away handling
+        self.timeout = self.server.tier.socket_timeout_s  # type: ignore[attr-defined]
+        super().setup()
+
     def log_message(self, fmt: str, *args: Any) -> None:
         pass  # metrics rows, not stderr chatter
 
@@ -315,7 +330,7 @@ class _Handler(BaseHTTPRequestHandler):
         its own span)."""
         fleet = self.tier.fleet
         futs = [fleet.submit(*row, trace=trace) for row in rows]
-        deadline = time.perf_counter() + SCORE_TIMEOUT_S
+        deadline = time.perf_counter() + self.tier.score_timeout_s
         return np.asarray([
             f.result(timeout=max(0.001, deadline - time.perf_counter()))
             for f in futs
@@ -494,7 +509,7 @@ class _Handler(BaseHTTPRequestHandler):
         k = self._request_k(doc)
         ctx = self._trace_ctx(fleet)
         futs = [fleet.submit(*row, trace=ctx) for row in rows]
-        deadline = time.perf_counter() + SCORE_TIMEOUT_S
+        deadline = time.perf_counter() + self.tier.score_timeout_s
         items, scores = [], []
         for f in futs:
             ids, sc, _ = f.result(  # 3rd: the producing index (cascade's)
@@ -574,8 +589,8 @@ class _Handler(BaseHTTPRequestHandler):
         except RolloutError as e:
             self._json(409, {"error": str(e)})
         except (TimeoutError, FutureTimeout) as e:
-            # admitted but the scoring future outlived SCORE_TIMEOUT_S:
-            # a gateway-timeout condition, not a server bug
+            # admitted but the scoring future outlived the tier's
+            # score_timeout_s: a gateway timeout, not a server bug
             self._json(504, {"error": f"scoring timed out: {e}"})
         except (ValueError, KeyError, json.JSONDecodeError,
                 struct.error) as e:
@@ -604,8 +619,20 @@ class ServeTier:
         drain_timeout_s: float = 30.0,
         default_canary_frac: float = 0.1,
         cascade=None,
+        score_timeout_s: float = SCORE_TIMEOUT_S,
+        socket_timeout_s: float = SOCKET_TIMEOUT_S,
     ):
         self.fleet = fleet
+        # timeout discipline (XF017): every handler wait is bounded —
+        # scoring futures by score_timeout_s (504 past it), socket
+        # reads/writes by socket_timeout_s (_Handler.setup).  The serve
+        # CLI wires these from Config.serve_{score,socket}_timeout_s.
+        if score_timeout_s <= 0 or socket_timeout_s <= 0:
+            raise ValueError(
+                "score_timeout_s and socket_timeout_s must be > 0"
+            )
+        self.score_timeout_s = score_timeout_s
+        self.socket_timeout_s = socket_timeout_s
         # retrieval→ranking cascade (serve/cascade.py): when set, the
         # tier additionally serves /v1/topk (the cascade's retrieval
         # fleet) and /v1/recommend, and rollout endpoints accept a

@@ -46,6 +46,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from xflow_tpu.chaos import failpoint
 from xflow_tpu.serve.artifact import servable_digest
 
 DELTA_MANIFEST = "delta_manifest.json"
@@ -126,6 +127,9 @@ def export_delta(
             "full artifacts (serve/artifact.py)"
         )
     cfg = trainer.cfg
+    # chaos site: writer fault mid-delta — the tmp-dir + rename
+    # atomicity below is what it exercises (XF018)
+    failpoint("delta.export")
     step = int(jax.device_get(trainer.state["step"]))
     keys = ledger.keys()
     parent = os.path.dirname(os.path.abspath(directory))
@@ -196,6 +200,7 @@ def load_delta_manifest(directory: str) -> dict:
     with the embedded config, and the content sha over keys + rows."""
     from xflow_tpu.config import Config
 
+    failpoint("delta.load")
     path = os.path.join(directory, DELTA_MANIFEST)
     if not os.path.exists(path):
         raise ValueError(
@@ -209,7 +214,15 @@ def load_delta_manifest(directory: str) -> dict:
             f"{directory}: unsupported delta format "
             f"{manifest.get('format')!r} (expected {DELTA_FORMAT})"
         )
-    cfg = Config.from_json(manifest["config"])
+    try:
+        cfg = Config.from_json(manifest["config"])
+    except TypeError as e:
+        # corrupted/transposed manifest keys reach Config.__init__ as
+        # bad kwargs — surface as the same typed refusal as any other
+        # malformed manifest, not a decoder crash
+        raise ValueError(
+            f"{directory}: delta manifest config is malformed: {e}"
+        ) from e
     if cfg.digest() != manifest.get("config_digest"):
         raise ValueError(
             f"{directory}: delta config_digest "
@@ -244,6 +257,7 @@ def apply_delta(engine, directory: str):
     servable — apply the intervening deltas in order, or load the
     fresh full base the compaction policy cut), content-sha mismatch
     (bytes corrupt)."""
+    failpoint("delta.apply")
     manifest = load_delta_manifest(directory)
     if manifest["config_digest"] != engine.digest:
         raise ValueError(

@@ -68,6 +68,9 @@ class IngestCursor:
         self.current: str | None = None
         self.offset: int = 0
         self._dirty = False
+        # chaos site: cursor read fault on restart — replay stays
+        # bounded by the at-least-once contract (XF018)
+        failpoint("stream.cursor")
         if os.path.exists(path):
             with open(path) as f:
                 raw = json.load(f)
@@ -116,6 +119,9 @@ class IngestCursor:
         the previous cursor intact, never a torn file."""
         if not self._dirty:
             return
+        # chaos site: kill mid-flush — tmp + os.replace must leave the
+        # previous cursor intact (XF018)
+        failpoint("stream.cursor")
         tmp = f"{self.path}.tmp.{os.getpid()}"
         payload = self.payload()
         parent = os.path.dirname(os.path.abspath(self.path))

@@ -250,6 +250,9 @@ def _write_latest(directory: str, name: str) -> None:
 def latest_checkpoint(directory: str) -> str | None:
     marker = os.path.join(directory, "LATEST")
     if os.path.exists(marker):
+        # metadata peek: a torn/missing marker falls through to the
+        # directory scan; restore itself carries ckpt.restore
+        # (xf: ignore[XF018])
         with open(marker) as f:
             name = f.read().strip()
         path = os.path.join(directory, name)
@@ -325,6 +328,10 @@ class RangeReader:
         self.dtype = dtype
 
     def read(self, idx: tuple) -> np.ndarray:
+        # chaos site: per-shard mmap read fault during restore/artifact
+        # load — distinct from ckpt.restore so mid-assembly faults are
+        # injectable (XF018)
+        failpoint("ckpt.read_shard")
         rows = idx[0] if idx else slice(None)
         a = rows.start or 0
         b = rows.stop if rows.stop is not None else self.shape[0]
