@@ -1,0 +1,465 @@
+"""Live-observability gate (tier-1): the telemetry plane of ISSUE 19
+must tell the truth while the tier is running, not just post-hoc.
+
+Four legs over one toy artifact:
+
+* **Healthy tier under load** — a 2-replica ServeTier takes open-loop
+  loadgen traffic while a scraper thread hammers ``GET /metrics``;
+  every scrape must parse and counters must be monotonic (the snapshot
+  IS the lock-safety — a torn read would show a counter going
+  backwards).  After traffic quiesces, one scrape must agree exactly
+  with the same-instant ``fleet.stats()`` registry snapshot, ``GET
+  /v1/stats`` must carry the watchdog's health state and the alert
+  summary, and the SLO evaluator must stay silent: a healthy leg that
+  pages is as broken as a sick leg that doesn't.
+* **Chaos leg** — ``serve.replica_score`` faults error a scoring
+  window; the ``serve_error_frac`` rule (and ONLY that rule) must fire
+  on the bad window and resolve on the next clean one, with both
+  ``alert`` rows landing schema-valid in the metrics stream.
+* **Exporter/sampler lifecycle** — the standalone ``MetricsExporter``
+  must serve over a real socket exactly what ``render_exposition``
+  says, and the threaded ``ResourceSampler`` must emit schema-valid
+  ``resource`` rows; both must leave ZERO threads behind after
+  ``close()``.
+* **Live-vs-post-hoc parity** — ``obs live --once`` on a finished (or
+  torn, still-growing) file must reach the same diagnosis codes and
+  exit verdict ``obs doctor`` reaches post-hoc.
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python scripts/check_live_obs.py
+
+Wired into tier-1 via tests/test_live_obs.py::test_check_live_obs_script.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# thread-name prefixes the fabrics under test own — none may survive
+_THREAD_PREFIXES = (
+    "xflow-serve", "xflow-replica-revive", "xflow-loadgen",
+    "xflow-obs-watchdog", "resource-sampler", "metrics-exporter",
+)
+
+
+def _leaked_threads() -> list[str]:
+    return sorted(
+        t.name for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith(_THREAD_PREFIXES)
+    )
+
+
+def _get(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _live_codes(path: str) -> tuple[set, int, list[str]]:
+    """(diagnosis codes, exit code, raw lines) from `obs live --once`."""
+    from xflow_tpu.obs.live import run_live
+
+    lines: list[str] = []
+    rc = run_live([path], once=True, out=lines.append)
+    codes = set()
+    for line in lines:
+        if line.startswith("[") and "] " in line:
+            head = line.split("] ", 1)[1]
+            codes.add(head.split(":", 1)[0])
+    return codes, rc, lines
+
+
+def _doctor_codes(path: str) -> tuple[set, int]:
+    """(diagnosis codes, exit code) the post-hoc doctor reaches."""
+    from xflow_tpu.obs.doctor import diagnose, merge_rows
+
+    findings = diagnose(merge_rows([path]))
+    rc = 1 if any(d.severity in ("crit", "warn") for d in findings) else 0
+    return {d.code for d in findings}, rc
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from tests.gen_data import generate_dataset
+    from xflow_tpu import chaos
+    from xflow_tpu.config import Config
+    from xflow_tpu.obs.export import (
+        MetricsExporter,
+        ResourceSampler,
+        parse_exposition,
+        render_exposition,
+    )
+    from xflow_tpu.obs.flight import FlightRecorder
+    from xflow_tpu.obs.live import AlertEvaluator
+    from xflow_tpu.obs.registry import MetricsRegistry
+    from xflow_tpu.obs.schema import load_jsonl, validate_rows
+    from xflow_tpu.obs.watchdog import Watchdog
+    from xflow_tpu.serve.artifact import export_artifact
+    from xflow_tpu.serve.fleet import ReplicaFleet
+    from xflow_tpu.serve.loadgen import run_loadgen
+    from xflow_tpu.serve.server import ServeTier
+    from xflow_tpu.trainer import Trainer
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    errors: list[str] = []
+    with tempfile.TemporaryDirectory() as root:
+        ds = generate_dataset(
+            os.path.join(root, "data"),
+            num_train_shards=2,
+            lines_per_shard=200,
+            num_fields=10,
+            vocab_per_field=8,
+            seed=19,
+            scale=3.0,
+        )
+        cfg = Config(
+            train_path=ds.train_prefix,
+            test_path=ds.test_prefix,
+            model="lr",
+            epochs=1,
+            batch_size=64,
+            table_size_log2=14,
+            max_nnz=24,
+            num_devices=1,
+        )
+        trainer = Trainer(cfg)
+        trainer.train()
+        artifact = export_artifact(trainer, os.path.join(root, "artifact"))
+        trainer.close()
+
+        # -- leg A: healthy tier under load, scraped live ------------------
+        metrics_a = os.path.join(root, "serve_healthy.jsonl")
+        logger = MetricsLogger(metrics_a, run_header={
+            "run_id": "live-obs-healthy",
+            "config_digest": "gate",
+            "rank": 0,
+            "num_hosts": 1,
+            "model": "lr",
+        })
+        flight = FlightRecorder()
+        fleet = ReplicaFleet.load(
+            artifact, replicas=2, buckets=(1, 8), warm=False,
+            metrics_logger=logger, flight=flight,
+        )
+        tier = ServeTier(fleet, port=0, flight=flight)
+        wd = Watchdog(flight, serve_s=30.0, metrics_logger=logger)
+        wd.set_pending("serve", fleet.pending)
+        wd.set_pending("http", lambda: tier.running)
+        alerts = AlertEvaluator(metrics_logger=logger)
+        sampler = ResourceSampler(
+            metrics_logger=logger, registry=fleet.registry
+        )
+        tier.watchdog = wd
+        tier.alerts = alerts
+        tier.start()
+        wd.start()
+
+        scrape_errors: list[str] = []
+        scrapes = [0]
+        stop_scraping = threading.Event()
+
+        def _scrape_loop() -> None:
+            last: dict[str, float] = {}
+            while not stop_scraping.is_set():
+                try:
+                    text = _get(f"{tier.address}/metrics").decode()
+                    parsed = parse_exposition(text)
+                except Exception as e:  # noqa: BLE001 — report, don't die
+                    scrape_errors.append(f"{type(e).__name__}: {e}")
+                    return
+                scrapes[0] += 1
+                for name, v in parsed["counter"].items():
+                    if v < last.get(name, 0.0):
+                        scrape_errors.append(
+                            f"counter {name} went backwards: "
+                            f"{last[name]} -> {v} (torn read)"
+                        )
+                        return
+                    last[name] = v
+                time.sleep(0.01)
+
+        scraper = threading.Thread(
+            target=_scrape_loop, name="live-obs-scraper"
+        )
+        scraper.start()
+        summary = run_loadgen(
+            fleet,
+            offered_qps=80.0,
+            duration_s=1.5,
+            concurrency=4,
+            nnz=8,
+            zipf_a=1.3,
+            seed=0,
+            metrics_logger=logger,
+        )
+        sampler.sample()
+        stop_scraping.set()
+        scraper.join(timeout=10.0)
+        errors.extend(f"scrape: {e}" for e in scrape_errors)
+        if scrapes[0] < 3:
+            errors.append(
+                f"only {scrapes[0]} successful scrape(s) during load — "
+                "the concurrent-scrape leg never really ran"
+            )
+
+        # scrape-vs-snapshot parity: traffic has quiesced (loadgen
+        # drained), both reads are non-destructive → exact agreement
+        scraped = parse_exposition(_get(f"{tier.address}/metrics").decode())
+        stats = fleet.stats()["stats"]
+        pairs = [
+            ("requests", scraped["counter"].get("xflow_serve_requests", 0.0)),
+            ("batches", scraped["counter"].get("xflow_serve_batches", 0.0)),
+            ("shed_total",
+             scraped["counter"].get("xflow_serve_shed_total", 0.0)),
+        ]
+        for field, got in pairs:
+            if int(got) != int(stats[field]):
+                errors.append(
+                    f"scrape/snapshot parity: {field} scraped {got} != "
+                    f"stats {stats[field]}"
+                )
+        q = scraped["summary"].get("xflow_serve_queue_seconds", {})
+        for label, field in (("0.5", "queue_p50"), ("0.99", "queue_p99")):
+            if round(q.get(label, 0.0), 6) != stats[field]:
+                errors.append(
+                    f"scrape/snapshot parity: queue {label} scraped "
+                    f"{q.get(label)} != stats {field} {stats[field]}"
+                )
+
+        # /v1/stats carries the watchdog state + alert summary
+        doc = json.loads(_get(f"{tier.address}/v1/stats"))
+        if "watchdog" not in doc or doc["watchdog"].get("healthy") is not True:
+            errors.append(
+                f"/v1/stats watchdog state missing or unhealthy on a "
+                f"healthy tier: {doc.get('watchdog')}"
+            )
+        if "alerts" not in doc or doc["alerts"].get("fired_total") != 0:
+            errors.append(
+                f"/v1/stats alert summary missing or non-silent on a "
+                f"healthy leg: {doc.get('alerts')}"
+            )
+
+        # the healthy leg must be alert-silent through the evaluator too
+        out = fleet.emit_stats()
+        fired = alerts.observe_rows([
+            dict(out["stats"], kind="serve_stats"),
+            dict(out["shed"], kind="serve_shed"),
+        ])
+        if fired:
+            errors.append(
+                f"healthy leg fired alert(s): "
+                f"{[(a['rule'], a['state']) for a in fired]}"
+            )
+        wd.stop()
+        tier.close()
+        logger.close()
+
+        rows_a = load_jsonl(metrics_a)
+        errors.extend(f"healthy leg: {e}" for e in validate_rows(rows_a))
+        if not any(r.get("kind") == "resource" for r in rows_a):
+            errors.append("healthy leg emitted no resource row")
+        if any(r.get("kind") == "alert" for r in rows_a):
+            errors.append("healthy leg logged alert row(s)")
+
+        # -- leg B: chaos fires the matching alert, then resolves ----------
+        metrics_b = os.path.join(root, "serve_chaos.jsonl")
+        logger_b = MetricsLogger(metrics_b, run_header={
+            "run_id": "live-obs-chaos",
+            "config_digest": "gate",
+            "rank": 0,
+            "num_hosts": 1,
+            "model": "lr",
+        })
+        reg = chaos.arm("seed=5;serve.replica_score:p=1,times=2")
+        chaos.attach_logger(logger_b)
+        # evictions off (high streak bar): this leg is about the alert
+        # plane, not the self-healing plane check_chaos.py already pins
+        fleet_b = ReplicaFleet.load(
+            artifact, replicas=2, buckets=(1, 8), warm=False,
+            metrics_logger=logger_b, evict_after_errors=100,
+        )
+        eval_b = AlertEvaluator(metrics_logger=logger_b)
+        rng = np.random.default_rng(0)
+        probes = [
+            rng.integers(0, cfg.table_size, size=8) for _ in range(6)
+        ]
+        faulted = 0
+        for keys in probes:
+            try:
+                fleet_b.score(keys)
+            except Exception:  # noqa: BLE001 — the injected fault
+                faulted += 1
+        if faulted < 1:
+            errors.append("chaos leg: serve.replica_score never surfaced")
+        t0 = 1_000_000.0
+        out_bad = fleet_b.emit_stats()
+        trans_bad = eval_b.observe_rows([
+            dict(out_bad["stats"], kind="serve_stats"),
+            dict(out_bad["shed"], kind="serve_shed"),
+        ], now=t0)
+        if [(a["rule"], a["state"]) for a in trans_bad] != [
+            ("serve_error_frac", "firing")
+        ]:
+            errors.append(
+                f"chaos window expected exactly serve_error_frac to "
+                f"fire, got {[(a['rule'], a['state']) for a in trans_bad]} "
+                f"(window {out_bad['shed']})"
+            )
+        chaos.disarm()
+        # clean window, 2 minutes later: the bad sample ages out of the
+        # short window, the rule resolves
+        for keys in probes:
+            fleet_b.score(keys)
+        out_ok = fleet_b.emit_stats()
+        trans_ok = eval_b.observe_rows([
+            dict(out_ok["stats"], kind="serve_stats"),
+            dict(out_ok["shed"], kind="serve_shed"),
+        ], now=t0 + 120.0)
+        if [(a["rule"], a["state"]) for a in trans_ok] != [
+            ("serve_error_frac", "resolved")
+        ]:
+            errors.append(
+                f"clean window expected serve_error_frac to resolve, "
+                f"got {[(a['rule'], a['state']) for a in trans_ok]}"
+            )
+        if eval_b.summary()["firing"]:
+            errors.append(
+                f"chaos leg left rules firing: {eval_b.summary()['firing']}"
+            )
+        fires = reg.fired().get("serve.replica_score", 0)
+        if fires < 1:
+            errors.append("chaos registry recorded no fires")
+        fleet_b.close()
+        chaos.detach_logger(logger_b)
+        chaos.disarm()
+        logger_b.close()
+
+        rows_b = load_jsonl(metrics_b)
+        errors.extend(f"chaos leg: {e}" for e in validate_rows(rows_b))
+        alert_states = [
+            (r["rule"], r["state"]) for r in rows_b
+            if r.get("kind") == "alert"
+        ]
+        if alert_states != [
+            ("serve_error_frac", "firing"),
+            ("serve_error_frac", "resolved"),
+        ]:
+            errors.append(
+                f"chaos leg alert rows: {alert_states} (want exactly "
+                "firing then resolved for serve_error_frac)"
+            )
+
+        # -- leg C: standalone exporter + threaded sampler lifecycle -------
+        reg_c = MetricsRegistry()
+        reg_c.counter_add("train.steps", 123)
+        reg_c.gauge_set("loader.depth", 4)
+        for v in (0.01, 0.02, 0.04):
+            reg_c.observe("step.seconds", v)
+        exporter = MetricsExporter(reg_c, port=0).start()
+        wire = _get(f"{exporter.address}/metrics").decode()
+        if wire != render_exposition(reg_c.snapshot(reset=False)):
+            errors.append(
+                "exporter served something other than the registry's "
+                "own exposition"
+            )
+        if json.loads(_get(f"{exporter.address}/healthz")).get(
+            "status"
+        ) != "exporting":
+            errors.append("exporter /healthz is not exporting")
+        metrics_c = os.path.join(root, "sampler.jsonl")
+        logger_c = MetricsLogger(metrics_c, run_header={
+            "run_id": "live-obs-sampler",
+            "config_digest": "gate",
+            "rank": 0,
+            "num_hosts": 1,
+            "model": "lr",
+        })
+        sampler_c = ResourceSampler(
+            metrics_logger=logger_c, registry=reg_c, interval_s=0.05
+        ).start()
+        time.sleep(0.2)
+        sampler_c.close()
+        exporter.close()
+        logger_c.close()
+        rows_c = load_jsonl(metrics_c)
+        errors.extend(f"sampler leg: {e}" for e in validate_rows(rows_c))
+        n_resource = sum(1 for r in rows_c if r.get("kind") == "resource")
+        if n_resource < 2:
+            errors.append(
+                f"threaded sampler emitted {n_resource} resource row(s), "
+                "want >= 2 (start + close at minimum)"
+            )
+        if "obs.resource.rss_bytes" not in reg_c.snapshot().gauges:
+            errors.append("sampler never mirrored gauges into the registry")
+
+        # -- leg D: obs live --once parity with post-hoc doctor ------------
+        live_codes, live_rc, _ = _live_codes(metrics_a)
+        doc_codes, doc_rc = _doctor_codes(metrics_a)
+        if live_codes != doc_codes or live_rc != doc_rc:
+            errors.append(
+                f"healthy-file parity: live {sorted(live_codes)} rc "
+                f"{live_rc} != doctor {sorted(doc_codes)} rc {doc_rc}"
+            )
+        # a sick, still-growing file: a watchdog trip plus a torn tail
+        sick = os.path.join(root, "sick.jsonl")
+        from xflow_tpu.obs.schema import health_row
+
+        with open(sick, "w") as f:
+            f.write(json.dumps({
+                "t": 0.0, "kind": "run_start", "run_id": "sick",
+                "time_unix": 100.0, "hostname": "h", "pid": 1,
+                "config_digest": "gate", "rank": 0, "num_hosts": 1,
+                "model": "lr",
+            }) + "\n")
+            f.write(json.dumps(dict(health_row(
+                cause="input_stall", channel="train",
+                silence_seconds=45.0, threshold_seconds=30.0,
+                detail="input_stall",
+            ), t=5.0, kind="health")) + "\n")
+            f.write('{"t": 9.0, "kind": "train_ep')  # torn, mid-append
+        live_codes, live_rc, live_lines = _live_codes(sick)
+        doc_codes, doc_rc = _doctor_codes(sick)
+        if live_codes != doc_codes or live_rc != doc_rc:
+            errors.append(
+                f"sick-file parity: live {sorted(live_codes)} rc "
+                f"{live_rc} != doctor {sorted(doc_codes)} rc {doc_rc} "
+                f"(live said: {live_lines})"
+            )
+        if live_rc != 1:
+            errors.append(
+                f"sick file (watchdog trip) exited {live_rc}, want 1"
+            )
+
+        leaked = _leaked_threads()
+        if leaked:
+            errors.append(f"leaked thread(s) survived the legs: {leaked}")
+
+    for e in errors:
+        print(f"FAIL: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print(
+        f"OK: {scrapes[0]} concurrent scrapes clean under "
+        f"{summary['requests']} loadgen requests; scrape==snapshot; "
+        f"healthy leg alert-silent; chaos leg fired+resolved "
+        f"serve_error_frac ({fires} injected fault(s)); exporter wire "
+        f"parity; {n_resource} threaded resource rows; live==doctor on "
+        "finished and torn files; no leaked threads"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

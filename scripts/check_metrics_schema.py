@@ -51,6 +51,10 @@ def run_toy_pipeline(root: str) -> str:
         max_nnz=24,
         num_devices=1,
         metrics_out=out,
+        # resource telemetry on (obs/export.py): the sampler thread
+        # emits at least the start + close `resource` rows here, so
+        # the schema lint covers the live-telemetry kinds too
+        obs_resource_every_s=0.2,
     )
     with Trainer(cfg) as t:
         t.train()
@@ -66,12 +70,33 @@ def check(path: str) -> list[str]:
     errors = validate_rows(rows)
 
     kinds = {r.get("kind") for r in rows}
-    for expected in ("run_start", "train_epoch", "eval", "shard"):
+    for expected in ("run_start", "train_epoch", "eval", "shard",
+                     "resource"):
         if expected not in kinds:
             errors.append(f"toy pipeline emitted no {expected!r} row")
     unknown = kinds - set(SCHEMA)
     if unknown:
         errors.append(f"kinds missing from SCHEMA: {sorted(unknown)}")
+
+    # the live-telemetry row constructors must themselves produce
+    # schema-valid rows — alert rows come from the SLO evaluator
+    # (obs/live.py), not the toy pipeline, so mint one directly
+    from xflow_tpu.obs.schema import alert_row, resource_row
+
+    synthetic = [
+        dict(alert_row(
+            rule="serve_error_frac", state="firing", value=0.5,
+            threshold=0.05, short_s=60.0, long_s=300.0, samples=3,
+            detail="lint",
+        ), t=0.0, kind="alert"),
+        dict(resource_row(
+            rss_bytes=1, cpu_seconds=0.1, threads=1, open_fds=1,
+            gc_collections=0,
+        ), t=0.0, kind="resource"),
+    ]
+    errors.extend(
+        f"constructor row: {e}" for e in validate_rows(synthetic)
+    )
 
     # the summarize accounting contract: exclusive phases cover the
     # run's wall-clock (ISSUE 1 acceptance: >= 90%)

@@ -1,0 +1,641 @@
+"""Live telemetry plane (ISSUE 19): Prometheus-style exposition +
+standalone exporter, rolling-window SLO alerting, host resource
+telemetry, the streaming doctor (`obs live`), and the torn-final-line
+tolerance of `obs merge` on still-appended files."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from xflow_tpu.obs.export import (
+    MetricsExporter,
+    ResourceSampler,
+    metric_name,
+    parse_exposition,
+    render_exposition,
+    sample_resources,
+)
+from xflow_tpu.obs.live import (
+    AlertEvaluator,
+    AlertRule,
+    LiveTailer,
+    default_rules,
+    run_live,
+)
+from xflow_tpu.obs.registry import MetricsRegistry
+from xflow_tpu.obs.schema import (
+    alert_row,
+    load_jsonl_tolerant,
+    resource_row,
+    validate_rows,
+)
+
+
+def _header(run_id="r1", rank=0, t0=100.0):
+    return {
+        "t": 0.0, "kind": "run_start", "run_id": run_id,
+        "time_unix": t0, "hostname": "h", "pid": 1,
+        "config_digest": "x", "rank": rank, "num_hosts": 1,
+        "model": "lr",
+    }
+
+
+# -- exposition -------------------------------------------------------------
+
+
+def test_metric_name_sanitization():
+    assert metric_name("serve.e2e.b8") == "xflow_serve_e2e_b8"
+    assert metric_name("a-b c", prefix="") == "a_b_c"
+    # a digit-leading name gets an underscore, per the exposition format
+    assert metric_name("9lives", prefix="") == "_9lives"
+
+
+def test_exposition_round_trips_registry_snapshot():
+    """parse(render(snapshot)) recovers every counter, gauge, and
+    histogram summary value — including the summary's companion _max
+    gauge folded back into the summary, not misread as a gauge."""
+    r = MetricsRegistry()
+    r.counter_add("serve.requests", 7)
+    r.counter_add("serve.shed_total", 2)
+    r.gauge_set("loader.depth", 3.5)
+    for v in (0.001, 0.01, 0.1, 1.0):
+        r.observe("serve.queue_seconds", v)
+    snap = r.snapshot(reset=False)
+    parsed = parse_exposition(render_exposition(snap))
+    assert parsed["counter"]["xflow_serve_requests"] == 7
+    assert parsed["counter"]["xflow_serve_shed_total"] == 2
+    assert parsed["gauge"]["xflow_loader_depth"] == 3.5
+    s = parsed["summary"]["xflow_serve_queue_seconds"]
+    h = snap.hists["serve.queue_seconds"]
+    assert s["count"] == h["count"]
+    assert s["0.5"] == h["p50"]
+    assert s["0.99"] == h["p99"]
+    assert s["max"] == h["max"]
+    assert s["sum"] == pytest.approx(h["mean"] * h["count"])
+    # and the _max line did NOT leak into the gauge family
+    assert "xflow_serve_queue_seconds_max" not in parsed["gauge"]
+
+
+def test_exposition_agrees_with_serve_stats_row():
+    """The exposition and stats_row_from_snapshot are two views of ONE
+    snapshot — the same registry read must produce agreeing numbers
+    (what the check_live_obs gate scrapes over HTTP)."""
+    from xflow_tpu.serve.batcher import stats_row_from_snapshot
+
+    r = MetricsRegistry()
+    r.counter_add("serve.requests", 10)
+    r.counter_add("serve.batches", 4)
+    for v in (0.002, 0.004, 0.008):
+        r.observe("serve.queue_seconds", v)
+        r.observe("serve.batch_size", 2.0)
+    snap = r.snapshot(reset=False)
+    row = stats_row_from_snapshot(snap)
+    parsed = parse_exposition(render_exposition(snap))
+    assert parsed["counter"]["xflow_serve_requests"] == row["requests"]
+    assert parsed["counter"]["xflow_serve_batches"] == row["batches"]
+    q = parsed["summary"]["xflow_serve_queue_seconds"]
+    assert round(q["0.5"], 6) == row["queue_p50"]
+    assert round(q["0.99"], 6) == row["queue_p99"]
+
+
+def test_exposition_concurrent_scrape_lock_stress():
+    """Writers hammer the registry while a render loop scrapes it,
+    SANITIZER-ARMED: no exception, every scrape parses, and counters
+    are monotonic across scrapes (a torn read would go backwards)."""
+    from xflow_tpu.analysis import LockOrderSanitizer, static_lock_order
+
+    r = MetricsRegistry()
+    san = LockOrderSanitizer()
+    san.instrument(r, "_lock", "MetricsRegistry._lock")
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def writer():
+        try:
+            while not stop.is_set():
+                r.counter_add("serve.requests")
+                r.observe("serve.queue_seconds", 0.001)
+                r.gauge_set("loader.depth", 1.0)
+        except BaseException as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    last = 0.0
+    try:
+        for _ in range(200):
+            parsed = parse_exposition(
+                render_exposition(r.snapshot(reset=False))
+            )
+            got = parsed["counter"].get("xflow_serve_requests", 0.0)
+            assert got >= last, "counter went backwards: torn read"
+            last = got
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors
+    assert last > 0
+    # observed acquisition orders are consistent with the static XF007
+    # lock-order graph (same cross-check as the batcher lock stress)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    static = static_lock_order([os.path.join(repo, "xflow_tpu")])
+    assert san.contradictions(static) == []
+
+
+# -- alert rules ------------------------------------------------------------
+
+
+def test_alert_rule_value_semantics():
+    rule = AlertRule(
+        "err", "serve_shed", "errors", threshold=0.1, denom="admitted"
+    )
+    assert rule.value({"kind": "other", "errors": 1, "admitted": 1}) is None
+    assert rule.value({"kind": "serve_shed", "admitted": 4}) is None
+    assert rule.value(
+        {"kind": "serve_shed", "errors": True, "admitted": 4}
+    ) is None  # bools are not samples
+    assert rule.value(
+        {"kind": "serve_shed", "errors": 1, "admitted": 0}
+    ) is None  # empty window: no denominator, no sample
+    assert rule.value(
+        {"kind": "serve_shed", "errors": 1, "admitted": 4}
+    ) == 0.25
+    plain = AlertRule("q", "serve_stats", "queue_p99", threshold=1.0)
+    assert plain.value({"kind": "serve_stats", "queue_p99": 2.5}) == 2.5
+
+
+def test_default_rules_unique_and_evaluator_rejects_duplicates():
+    names = [r.name for r in default_rules()]
+    assert len(set(names)) == len(names)
+    with pytest.raises(ValueError, match="duplicate"):
+        AlertEvaluator(rules=[
+            AlertRule("a", "eval", "auc", 1.0),
+            AlertRule("a", "eval", "auc", 2.0),
+        ])
+
+
+def test_burn_rate_needs_both_windows():
+    """Multi-window semantics: a short spike over a healthy long
+    window does NOT fire (the long mean gates it); a sustained breach
+    fires; a clean short window resolves even while the long window
+    still remembers the breach."""
+    rule = AlertRule(
+        "err", "serve_shed", "frac", threshold=0.1,
+        short_s=60.0, long_s=300.0,
+    )
+    ev = AlertEvaluator(rules=[rule])
+    t0 = 1_000.0
+    # 4 healthy samples spread over the long window
+    for i in range(4):
+        assert ev.observe_rows(
+            [{"kind": "serve_shed", "frac": 0.0, "time_unix": t0 + i * 50}]
+        ) == []
+    # one spike: short mean 1.0 > 0.1, but long mean 1/5 = 0.2... that
+    # fires; use a diluted spike instead: long mean 0.4/5 = 0.08 < 0.1
+    spike = ev.observe_rows(
+        [{"kind": "serve_shed", "frac": 0.4, "time_unix": t0 + 200}]
+    )
+    assert spike == []  # long window vetoes the page
+    # sustained breach: short AND long means cross the threshold
+    fired = []
+    for i in range(4):
+        fired += ev.observe_rows([
+            {"kind": "serve_shed", "frac": 0.4,
+             "time_unix": t0 + 210 + i * 10}
+        ])
+    assert [(a["rule"], a["state"]) for a in fired] == [("err", "firing")]
+    assert ev.summary()["firing"] == ["err"]
+    # clean short window resolves (old breach still inside long_s)
+    resolved = ev.observe_rows(
+        [{"kind": "serve_shed", "frac": 0.0, "time_unix": t0 + 310}],
+        now=t0 + 310,
+    )
+    assert [(a["rule"], a["state"]) for a in resolved] == [
+        ("err", "resolved")
+    ]
+    assert ev.summary()["firing"] == []
+    assert ev.summary()["fired_total"] == 1
+    assert ev.summary()["resolved_total"] == 1
+
+
+def test_alert_rows_land_in_metrics_stream_and_validate(tmp_path):
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    out = tmp_path / "m.jsonl"
+    logger = MetricsLogger(str(out), run_header=_header())
+    ev = AlertEvaluator(metrics_logger=logger)
+    t0 = 1_000.0
+    ev.observe_rows(
+        [{"kind": "serve_shed", "errors": 5, "admitted": 10,
+          "time_unix": t0}], now=t0,
+    )
+    ev.observe_rows(
+        [{"kind": "serve_shed", "errors": 0, "admitted": 10,
+          "time_unix": t0 + 120}], now=t0 + 120,
+    )
+    logger.close()
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert validate_rows(rows) == []
+    states = [
+        (r["rule"], r["state"]) for r in rows if r["kind"] == "alert"
+    ]
+    assert states == [
+        ("serve_error_frac", "firing"), ("serve_error_frac", "resolved"),
+    ]
+
+
+def test_doctor_consumes_alert_rows_as_evidence():
+    from xflow_tpu.obs.doctor import diagnose
+
+    base = alert_row(
+        rule="serve_error_frac", state="firing", value=0.5,
+        threshold=0.05, short_s=60, long_s=300, samples=3, detail="d",
+    )
+    firing = [_header(), dict(base, t=1.0, kind="alert")]
+    codes = {(d.severity, d.code) for d in diagnose(firing)}
+    assert ("warn", "alert_firing") in codes
+    resolved = firing + [dict(
+        alert_row(
+            rule="serve_error_frac", state="resolved", value=0.0,
+            threshold=0.05, short_s=60, long_s=300, samples=2,
+            detail="d",
+        ), t=2.0, kind="alert",
+    )]
+    codes = {(d.severity, d.code) for d in diagnose(resolved)}
+    assert ("info", "alert_resolved") in codes
+    assert ("warn", "alert_firing") not in codes
+
+
+# -- torn-line tolerance (obs merge on a still-appended file) ---------------
+
+
+def test_load_jsonl_tolerant_skips_torn_final_line(tmp_path):
+    p = tmp_path / "m.jsonl"
+    p.write_text(
+        json.dumps(_header()) + "\n"
+        + json.dumps({"t": 1.0, "kind": "eval", "auc": 0.5,
+                      "logloss": 0.6, "examples": 10}) + "\n"
+        + '{"t": 2.0, "kind": "ev'  # writer mid-append
+    )
+    rows, skipped = load_jsonl_tolerant(str(p))
+    assert skipped == 1
+    assert [r["kind"] for r in rows] == ["run_start", "eval"]
+    # a torn MIDDLE line is corruption, not appending — still fatal
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(
+        json.dumps(_header()) + "\n" + '{"torn\n'
+        + json.dumps({"t": 1.0, "kind": "eval"}) + "\n"
+    )
+    with pytest.raises(ValueError, match="not valid JSON"):
+        load_jsonl_tolerant(str(bad))
+
+
+def test_merge_tolerates_still_appended_file(tmp_path):
+    """Satellite regression pin: `obs merge` over a file whose final
+    line is torn (still being appended) merges the complete rows and
+    REPORTS the skip instead of failing."""
+    from xflow_tpu.obs.doctor import merge_rows_tolerant
+
+    a = tmp_path / "a.jsonl"
+    a.write_text(
+        json.dumps(_header(run_id="a", rank=0)) + "\n"
+        + json.dumps({"t": 1.0, "kind": "eval", "auc": 0.5}) + "\n"
+        + '{"t": 2.0, "kind"'
+    )
+    b = tmp_path / "b.jsonl"
+    b.write_text(
+        json.dumps(_header(run_id="b", rank=1, t0=100.5)) + "\n"
+        + json.dumps({"t": 1.0, "kind": "eval", "auc": 0.6}) + "\n"
+    )
+    rows, skipped = merge_rows_tolerant([str(a), str(b)])
+    assert skipped == 1
+    assert len(rows) == 4
+    assert all("time_unix" in r and "rank" in r for r in rows)
+    # the CLI surface: exit 0 with the skip reported, rows on stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "xflow_tpu.obs", "merge",
+         str(a), str(b)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert len(proc.stdout.splitlines()) == 4
+    assert "1 torn final line(s) skipped" in proc.stderr
+
+
+# -- live tailer / run_live -------------------------------------------------
+
+
+def test_live_tailer_incremental_and_torn_tail(tmp_path):
+    p = tmp_path / "m.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps(_header(t0=50.0)) + "\n")
+        f.write(json.dumps({"t": 1.0, "kind": "eval", "auc": 0.5}) + "\n")
+        f.write('{"t": 2.0, "kind": "ev')  # torn tail
+    tailer = LiveTailer([str(p)])
+    first = tailer.poll()
+    assert [r["kind"] for r in first] == ["run_start", "eval"]
+    assert first[1]["time_unix"] == 51.0  # t0 + t tagging, like merge
+    assert tailer.skipped == 0
+    assert tailer.poll() == []  # torn tail waits in the file
+    with open(p, "a") as f:
+        f.write('al", "auc": 0.6}\n')  # writer finishes the line
+        f.write("garbage-not-json\n")  # a COMPLETE unparseable line
+        f.write(json.dumps({"t": 3.0, "kind": "eval", "auc": 0.7}) + "\n")
+    second = tailer.poll()
+    assert [r.get("auc") for r in second] == [0.6, 0.7]
+    assert tailer.skipped == 1  # counted, not fatal
+    # a path that does not exist yet is tailed, not crashed on
+    ghost = LiveTailer([str(tmp_path / "ghost.jsonl")])
+    assert ghost.poll() == []
+
+
+def test_run_live_once_matches_post_hoc_doctor(tmp_path):
+    """The acceptance pin: on the same (finished or torn) file, `obs
+    live --once` reaches the diagnosis codes and verdict `obs doctor`
+    reaches post-hoc."""
+    from xflow_tpu.obs.doctor import diagnose, merge_rows
+    from xflow_tpu.obs.schema import health_row
+
+    p = tmp_path / "sick.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps(_header()) + "\n")
+        f.write(json.dumps(dict(health_row(
+            cause="input_stall", channel="train",
+            silence_seconds=45.0, threshold_seconds=30.0,
+            detail="input_stall",
+        ), t=5.0, kind="health")) + "\n")
+        f.write('{"t": 9.0, "kind": "tr')  # still growing
+    lines: list[str] = []
+    rc = run_live([str(p)], once=True, out=lines.append)
+    post = diagnose(merge_rows([str(p)]))
+    live_codes = {
+        l.split("] ", 1)[1].split(":", 1)[0]
+        for l in lines
+        if l.startswith("[") and not l.startswith("[ALERT]")
+    }
+    assert live_codes == {d.code for d in post}
+    post_rc = (
+        1 if any(d.severity in ("crit", "warn") for d in post) else 0
+    )
+    assert rc == post_rc == 1  # the stall IS a warn, both agree
+
+
+def test_run_live_streams_alert_transitions(tmp_path):
+    """run_live evaluates the SLO rules on rows as they appear: a bad
+    window already in the file fires on the first poll and is printed
+    as an [ALERT] line; exit code goes bad while it stays firing."""
+    p = tmp_path / "m.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps(_header(t0=time.time())) + "\n")
+        f.write(json.dumps({
+            "t": 1.0, "kind": "serve_shed", "admitted": 10,
+            "completed": 5, "shed_total": 0, "shed_frac": 0.0,
+            "by_cause": {}, "errors": 5, "depth": 0,
+            "queue_age_s": 0.0,
+        }) + "\n")
+    lines: list[str] = []
+    rc = run_live([str(p)], once=True, out=lines.append)
+    assert rc == 1
+    assert any(
+        l.startswith("[ALERT] serve_error_frac firing") for l in lines
+    )
+    assert any("firing now: ['serve_error_frac']" in l for l in lines)
+
+
+def test_obs_live_cli(tmp_path):
+    p = tmp_path / "m.jsonl"
+    p.write_text(json.dumps(_header()) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "xflow_tpu.obs", "live", str(p),
+         "--once"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "obs live" in proc.stdout
+
+
+# -- resource telemetry -----------------------------------------------------
+
+
+def test_sample_resources_schema_valid():
+    row = sample_resources()
+    assert validate_rows([dict(row, t=0.0, kind="resource")]) == []
+    assert row["rss_bytes"] > 0
+    assert row["cpu_seconds"] > 0
+    assert row["threads"] >= 1
+    assert row["open_fds"] > 0
+
+
+def test_resource_sampler_inline_sample_mirrors_gauges(tmp_path):
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    out = tmp_path / "m.jsonl"
+    logger = MetricsLogger(str(out), run_header=_header())
+    reg = MetricsRegistry()
+    sampler = ResourceSampler(metrics_logger=logger, registry=reg)
+    body = sampler.sample()
+    logger.close()
+    gauges = reg.snapshot().gauges
+    assert gauges["obs.resource.rss_bytes"] == float(body["rss_bytes"])
+    assert gauges["obs.resource.threads"] == float(body["threads"])
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert validate_rows(rows) == []
+    assert sum(1 for r in rows if r["kind"] == "resource") == 1
+
+
+def test_resource_sampler_thread_lifecycle(tmp_path):
+    from xflow_tpu.utils.logging import MetricsLogger
+
+    with pytest.raises(ValueError, match="interval_s"):
+        ResourceSampler(interval_s=0.0)
+    out = tmp_path / "m.jsonl"
+    logger = MetricsLogger(str(out), run_header=_header())
+    reg = MetricsRegistry()
+    sampler = ResourceSampler(
+        metrics_logger=logger, registry=reg, interval_s=0.02
+    ).start()
+    time.sleep(0.1)
+    sampler.close()
+    sampler.close()  # idempotent
+    logger.close()
+    assert not any(
+        t.name == "resource-sampler" for t in threading.enumerate()
+    )
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    n = sum(1 for r in rows if r["kind"] == "resource")
+    assert n >= 2  # the immediate first sample + the close() sample
+    # the XF009 heartbeat gauge beat at least once mid-loop
+    assert "obs.resource.beat_unix" in reg.snapshot().gauges
+
+
+# -- standalone exporter ----------------------------------------------------
+
+
+def test_metrics_exporter_serves_registry_and_reaps():
+    reg = MetricsRegistry()
+    reg.counter_add("train.steps", 42)
+    with pytest.raises(ValueError, match="timeout_s"):
+        MetricsExporter(reg, timeout_s=0.0)
+    exporter = MetricsExporter(reg, port=0).start()
+    try:
+        url = f"{exporter.address}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            text = r.read().decode()
+        assert parse_exposition(text)["counter"][
+            "xflow_train_steps"
+        ] == 42
+        # live: a counter bump shows on the NEXT scrape (no caching)
+        reg.counter_add("train.steps", 1)
+        with urllib.request.urlopen(url, timeout=10) as r:
+            text = r.read().decode()
+        assert parse_exposition(text)["counter"][
+            "xflow_train_steps"
+        ] == 43
+        with urllib.request.urlopen(
+            f"{exporter.address}/healthz", timeout=10
+        ) as r:
+            assert json.load(r)["status"] == "exporting"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"{exporter.address}/nope", timeout=10
+            )
+    finally:
+        exporter.close()
+    assert not any(
+        t.name == "metrics-exporter" for t in threading.enumerate()
+    )
+
+
+def test_trainer_reaps_exporter_and_sampler(toy_dataset, tmp_path):
+    """Config.obs_export_port + obs_resource_every_s through the real
+    Trainer: /metrics serves during the run, close() reaps both
+    threads (XF006), and the resource rows land schema-valid."""
+    from xflow_tpu.config import Config
+    from xflow_tpu.trainer import Trainer
+
+    out = tmp_path / "m.jsonl"
+    cfg = Config(
+        train_path=toy_dataset.train_prefix,
+        test_path=toy_dataset.test_prefix,
+        model="lr",
+        epochs=1,
+        batch_size=64,
+        table_size_log2=14,
+        max_nnz=24,
+        num_devices=1,
+        metrics_out=str(out),
+        obs_export_port=0,  # off: picking a fixed port races CI boxes
+        obs_resource_every_s=0.5,
+    )
+    t = Trainer(cfg)
+    # attach an exporter the way Config.obs_export_port would, but on
+    # an OS-assigned port (the config path needs a fixed one)
+    from xflow_tpu.obs.export import MetricsExporter
+
+    assert t._exporter is None
+    t._exporter = MetricsExporter(t.obs.registry, port=0).start()
+    t.train()
+    with urllib.request.urlopen(
+        f"{t._exporter.address}/metrics", timeout=10
+    ) as r:
+        assert r.status == 200
+    t.close()
+    assert not any(
+        thr.name in ("resource-sampler", "metrics-exporter")
+        for thr in threading.enumerate()
+    )
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert validate_rows(rows) == []
+    assert any(r["kind"] == "resource" for r in rows)
+
+
+def test_config_validates_live_obs_knobs(toy_dataset):
+    from xflow_tpu.config import Config
+
+    base = dict(
+        train_path=toy_dataset.train_prefix,
+        test_path=toy_dataset.test_prefix,
+        model="lr",
+    )
+    with pytest.raises(ValueError, match="obs_export_port"):
+        Config(obs_export_port=70000, **base)
+    with pytest.raises(ValueError, match="obs_resource_every_s"):
+        Config(obs_resource_every_s=-1.0, **base)
+    with pytest.raises(ValueError, match="metrics_out"):
+        Config(obs_resource_every_s=5.0, **base)
+    Config(obs_export_port=9100, **base)  # valid port, no exporter yet
+
+
+# -- schema constructors ----------------------------------------------------
+
+
+def test_alert_and_resource_constructors_schema_valid():
+    rows = [
+        dict(alert_row(
+            rule="r", state="firing", value=1.234567891,
+            threshold=0.05, short_s=60, long_s=300, samples=3,
+            detail="d",
+        ), t=0.0, kind="alert"),
+        dict(resource_row(
+            rss_bytes=1, cpu_seconds=2.5, threads=3, open_fds=4,
+            gc_collections=5,
+        ), t=0.0, kind="resource"),
+    ]
+    assert validate_rows(rows) == []
+    assert rows[0]["value"] == round(1.234567891, 6)
+
+
+def test_watchdog_state_surface():
+    """Watchdog.state() — the /v1/stats enrichment — reports health,
+    open incidents, and the last health row, all lock-guarded."""
+    from xflow_tpu.obs.flight import FlightRecorder
+    from xflow_tpu.obs.watchdog import Watchdog
+
+    flight = FlightRecorder()
+    wd = Watchdog(flight, input_s=0.01, device_s=10.0, serve_s=10.0)
+    state = wd.state()
+    assert state["healthy"] is True
+    assert state["incidents"] == {}
+    assert state["last"] is None
+    flight.note_phase("input_stall")
+    time.sleep(0.03)
+    wd.check()  # trips input_stall (silence > input_s)
+    state = wd.state()
+    assert state["healthy"] is False
+    assert state["incidents"]["train"]["cause"] == "input_stall"
+    assert state["trip_count"] == 1
+    assert state["last"]["cause"] == "input_stall"
+    flight.note_phase("step")  # fresh beat -> recovery
+    wd.check()
+    state = wd.state()
+    assert state["healthy"] is True
+    assert state["last"]["cause"] == "recovered:input_stall"
+
+
+# -- the tier-1 gate --------------------------------------------------------
+
+
+def test_check_live_obs_script():
+    """scripts/check_live_obs.py passes end to end — run as a
+    subprocess exactly as CI would."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "scripts", "check_live_obs.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

@@ -156,6 +156,19 @@ class Config:
     # production (zero overhead when off: plain threading.Lock stays).
     # The XFLOW_LOCK_SANITIZER env var arms the same machinery.
     obs_lock_sanitizer: bool = False
+    # Standalone Prometheus-style exposition (obs/export.py): serve
+    # `GET /metrics` on 127.0.0.1:<port> from the live metrics
+    # registry for training/stream runs, which have no HTTP surface of
+    # their own (the serving tier exposes /metrics on its own port
+    # instead).  0 = off.  The exporter thread is owned and reaped by
+    # Trainer.close().  Multi-host runs add the rank to the port so N
+    # trainers on one box never collide.
+    obs_export_port: int = 0
+    # Host resource sampler (obs/export.py): emit a `resource` JSONL
+    # row (RSS, CPU seconds, threads, open fds, GC collections) every
+    # N seconds while training, plus one at start and one at close.
+    # 0 = off.  Requires metrics_out (the rows need somewhere to go).
+    obs_resource_every_s: float = 0.0
 
     # -- eval / artifacts --
     # Prediction dump target.  With pred_style="single" (default) rank 0
@@ -637,6 +650,20 @@ class Config:
                 raise ValueError("watchdog thresholds must be > 0")
             if self.obs_watchdog_poll_s < 0:
                 raise ValueError("obs_watchdog_poll_s must be >= 0")
+        if not 0 <= self.obs_export_port <= 65535:
+            raise ValueError(
+                "obs_export_port must be in [0, 65535] (0 = exporter "
+                "off)"
+            )
+        if self.obs_resource_every_s < 0:
+            raise ValueError(
+                "obs_resource_every_s must be >= 0 (0 = sampler off)"
+            )
+        if self.obs_resource_every_s > 0 and not self.metrics_out:
+            raise ValueError(
+                "obs_resource_every_s requires metrics_out — the "
+                "resource rows need a metrics stream to land in"
+            )
 
     @property
     def table_size(self) -> int:

@@ -14,10 +14,15 @@
                               run: stall causes, stragglers, recompile
                               suspicion (--flight DUMP, --bench JSON);
                               exit 0 only when clean
+    live      run.jsonl ...   streaming doctor: tail growing metrics
+                              files, run the doctor checks plus the
+                              SLO alert rules continuously
+                              (--interval-s, --max-seconds, --once)
 
 Pure host-side file processing — never imports jax, so it runs
 anywhere (including hosts with no accelerator runtime).
-Docs: docs/OBSERVABILITY.md ("Diagnosing a sick run").
+Docs: docs/OBSERVABILITY.md ("Diagnosing a sick run",
+"Operating a live fleet").
 """
 
 from __future__ import annotations
@@ -65,6 +70,27 @@ def main(argv: list[str] | None = None) -> int:
     pd.add_argument(
         "--bench", default="", help="bench artifact (BENCH_r*.json)"
     )
+    pl = sub.add_parser(
+        "live", help="streaming doctor over growing metrics files"
+    )
+    pl.add_argument(
+        "paths", nargs="+",
+        help="metrics JSONL file(s), possibly still being written "
+        "(one per host)",
+    )
+    pl.add_argument(
+        "--interval-s", type=float, default=2.0,
+        help="poll cadence (default 2s)",
+    )
+    pl.add_argument(
+        "--max-seconds", type=float, default=0.0,
+        help="stop after this long (default 0 = until Ctrl-C)",
+    )
+    pl.add_argument(
+        "--once", action="store_true",
+        help="single pass over what exists now, then exit — a "
+        "file-tolerant `doctor` for still-growing files",
+    )
     args = p.parse_args(argv)
 
     if args.cmd == "summarize":
@@ -92,24 +118,43 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         return 0
     if args.cmd == "merge":
-        from xflow_tpu.obs.doctor import merge_rows, write_jsonl
+        from xflow_tpu.obs.doctor import merge_rows_tolerant, write_jsonl
 
         try:
-            rows = merge_rows(args.paths)
+            rows, skipped = merge_rows_tolerant(args.paths)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
+        torn = (
+            f", {skipped} torn final line(s) skipped (still-appended "
+            "file)" if skipped else ""
+        )
         if args.out:
             with open(args.out, "w") as f:
                 write_jsonl(rows, f)
             print(
                 f"{args.out}: {len(rows)} rows merged from "
-                f"{len(args.paths)} file(s)",
+                f"{len(args.paths)} file(s){torn}",
                 file=sys.stderr,
             )
         else:
             write_jsonl(rows, sys.stdout)
+            if skipped:
+                print(
+                    f"{skipped} torn final line(s) skipped "
+                    "(still-appended file)",
+                    file=sys.stderr,
+                )
         return 0
+    if args.cmd == "live":
+        from xflow_tpu.obs.live import run_live
+
+        return run_live(
+            args.paths,
+            interval_s=args.interval_s,
+            max_seconds=args.max_seconds,
+            once=args.once,
+        )
     if args.cmd == "doctor":
         from xflow_tpu.obs.doctor import doctor
 

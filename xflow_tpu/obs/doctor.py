@@ -13,6 +13,8 @@ diagnosis instead of raw JSONL:
 
 * watchdog ``health`` rows → dominant stall cause with trip counts and
   worst silence;
+* SLO ``alert`` rows (obs/live.py burn-rate evaluator) → rules still
+  firing at end of stream (warn) vs fired-and-resolved (info);
 * flight dump → why the run died and what every thread was doing;
 * phase accounting → the dominant wall-clock phase, with an
   input-bound callout when stalls dominate;
@@ -49,7 +51,7 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass
 
-from xflow_tpu.obs.schema import load_jsonl
+from xflow_tpu.obs.schema import load_jsonl, load_jsonl_tolerant
 from xflow_tpu.obs.summary import split_runs
 
 # straggler: slowest rank's mean step-time p50 vs the fleet median
@@ -135,9 +137,20 @@ class Diagnosis:
 
 def merge_rows(paths: list[str]) -> list[dict]:
     """Rank-tagged, time-aligned union of per-host metrics files."""
+    return merge_rows_tolerant(paths)[0]
+
+
+def merge_rows_tolerant(paths: list[str]) -> tuple[list[dict], int]:
+    """``merge_rows`` plus the count of torn final lines skipped: a
+    file that is still being APPENDED to legitimately ends mid-line,
+    and merging live files is exactly what `obs live` and a mid-run
+    `obs merge` do.  Torn middles still raise (corruption)."""
     merged: list[dict] = []
+    skipped = 0
     for path in paths:
-        for run in split_runs(load_jsonl(path)):
+        rows, torn = load_jsonl_tolerant(path)
+        skipped += torn
+        for run in split_runs(rows):
             header = run.header or {}
             rank = int(header.get("rank", 0))
             t0 = float(header.get("time_unix", 0.0))
@@ -155,7 +168,7 @@ def merge_rows(paths: list[str]) -> list[dict]:
                 )
                 merged.append(out)
     merged.sort(key=lambda r: r.get("time_unix", 0.0))
-    return merged
+    return merged, skipped
 
 
 def write_jsonl(rows: list[dict], f) -> None:
@@ -249,6 +262,44 @@ def _check_health(rows: list[dict]) -> list[Diagnosis]:
             f"{d.get('active_phase', '?')!r}) — pass it via --flight "
             "for thread stacks",
         ))
+    return out
+
+
+def _check_alerts(rows: list[dict]) -> list[Diagnosis]:
+    """``alert`` rows (obs/live.py AlertEvaluator) as first-class
+    evidence: a rule whose LAST transition is still ``firing`` is an
+    open problem; a fire→resolve pair is context worth naming (the
+    SLO was breached mid-run even though it recovered)."""
+    last: dict[str, dict] = {}
+    fired: dict[str, int] = {}
+    for r in rows:
+        if r.get("kind") != "alert":
+            continue
+        rule = str(r.get("rule", "?"))
+        last[rule] = r
+        if r.get("state") == "firing":
+            fired[rule] = fired.get(rule, 0) + 1
+    out = []
+    for rule, r in sorted(last.items()):
+        n = fired.get(rule, 0)
+        if r.get("state") == "firing":
+            out.append(Diagnosis(
+                "warn",
+                "alert_firing",
+                f"alert {rule} is FIRING (fired {n}x, last value "
+                f"{r.get('value')} vs threshold {r.get('threshold')} "
+                f"over {r.get('short_s')}s/{r.get('long_s')}s "
+                f"windows): {r.get('detail', '')}",
+            ))
+        elif n:
+            out.append(Diagnosis(
+                "info",
+                "alert_resolved",
+                f"alert {rule} fired {n}x and resolved (last value "
+                f"{r.get('value')} vs threshold "
+                f"{r.get('threshold')}) — the SLO was breached "
+                "mid-run even though it recovered",
+            ))
     return out
 
 
@@ -938,6 +989,7 @@ def diagnose(
     """Every check, ranked most-severe-first (stable within rank)."""
     findings: list[Diagnosis] = []
     findings.extend(_check_health(rows))
+    findings.extend(_check_alerts(rows))
     findings.extend(_check_chaos(rows))
     findings.extend(_check_serve(
         rows,

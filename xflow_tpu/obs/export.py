@@ -1,0 +1,347 @@
+"""Live telemetry exposition + host resource telemetry (ISSUE 19).
+
+Two exports over the existing MetricsRegistry fabric, both stdlib-only:
+
+* ``render_exposition`` — a Prometheus-style text rendering of one
+  registry snapshot.  Counters and gauges map 1:1; histograms render as
+  summaries (p50/p90/p99 quantile lines + ``_count``/``_sum``/``_max``).
+  The snapshot IS the lock-safety: ``MetricsRegistry.snapshot(
+  reset=False)`` copies every structure under the registry lock, so a
+  scrape can never observe a half-written histogram ring.  Served at
+  ``GET /metrics`` on ServeTier (serve/server.py) and by the standalone
+  ``MetricsExporter`` below for training/stream runs
+  (``Config.obs_export_port``).
+
+* ``ResourceSampler`` — process resource telemetry (RSS, CPU seconds,
+  thread count, open fds, GC collections) as ``resource`` JSONL rows
+  plus ``obs.resource.*`` registry gauges, so a leak or a CPU-bound
+  straggler lands in the same stream as the metrics it distorts.
+  Inline (``sample()`` from the serve CLI stats tick) or threaded
+  (``start()``/``close()`` from the Trainer, XF006 lifecycle).
+
+docs/OBSERVABILITY.md "Operating a live fleet" documents the format.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from xflow_tpu.obs.registry import Snapshot
+from xflow_tpu.obs.schema import resource_row
+
+# quantile label in the exposition -> key in Histogram.summary()
+_QUANTILES = (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99"))
+
+# per-connection socket deadline on the standalone exporter (XF017
+# discipline even though obs/ is outside the rule's static domain: a
+# scraper that stalls mid-request must not pin a handler thread)
+EXPORTER_TIMEOUT_S = 10.0
+
+
+def metric_name(name: str, prefix: str = "xflow") -> str:
+    """Registry name -> exposition name: ``serve.e2e.b8`` ->
+    ``xflow_serve_e2e_b8`` ([a-zA-Z0-9_] only, prefixed)."""
+    safe = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    if safe and safe[0].isdigit():
+        safe = "_" + safe
+    return f"{prefix}_{safe}" if prefix else safe
+
+
+def _fmt(v: float) -> str:
+    # repr keeps full float precision (round-trip exactness is what the
+    # scrape-vs-snapshot parity gate checks); integers render bare
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render_exposition(snap: Snapshot, prefix: str = "xflow") -> str:
+    """One registry snapshot as Prometheus text exposition format.
+
+    Rendered names sort deterministically; every histogram becomes a
+    summary family: quantile lines over the percentile ring, ``_count``
+    and ``_sum`` (= count * mean) over all time, ``_max`` as a
+    companion gauge (not part of the summary spec, but the watermark is
+    too diagnostic to drop)."""
+    out: list[str] = []
+    for name in sorted(snap.counters):
+        m = metric_name(name, prefix)
+        out.append(f"# TYPE {m} counter")
+        out.append(f"{m} {_fmt(snap.counters[name])}")
+    for name in sorted(snap.gauges):
+        m = metric_name(name, prefix)
+        out.append(f"# TYPE {m} gauge")
+        out.append(f"{m} {_fmt(snap.gauges[name])}")
+    for name in sorted(snap.hists):
+        m = metric_name(name, prefix)
+        h = snap.hists[name]
+        out.append(f"# TYPE {m} summary")
+        for label, key in _QUANTILES:
+            out.append(f'{m}{{quantile="{label}"}} {_fmt(h[key])}')
+        count = h.get("count", 0)
+        out.append(f"{m}_count {_fmt(count)}")
+        out.append(f"{m}_sum {_fmt(h.get('mean', 0.0) * count)}")
+        out.append(f"# TYPE {m}_max gauge")
+        out.append(f"{m}_max {_fmt(h.get('max', 0.0))}")
+    return "\n".join(out) + "\n"
+
+
+def parse_exposition(text: str) -> dict[str, dict]:
+    """Inverse of ``render_exposition`` (tests + the check_live_obs
+    gate): ``{"counter": {name: v}, "gauge": {...}, "summary":
+    {name: {"0.5": v, "0.9": v, "0.99": v, "count": n, "sum": s,
+    "max": m}}}`` keyed by EXPOSITION names."""
+    types: dict[str, str] = {}
+    out: dict[str, dict] = {"counter": {}, "gauge": {}, "summary": {}}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        name, value = line.rsplit(None, 1)
+        v = float(value)
+        if "{" in name:
+            base, label = name.split("{", 1)
+            q = label.split('"')[1]
+            out["summary"].setdefault(base, {})[q] = v
+        elif types.get(name) == "counter":
+            out["counter"][name] = v
+        elif types.get(name) == "gauge":
+            base = name[: -len("_max")] if name.endswith("_max") else ""
+            if types.get(base) == "summary":
+                out["summary"].setdefault(base, {})["max"] = v
+            else:
+                out["gauge"][name] = v
+        else:
+            for suffix in ("_count", "_sum"):
+                if name.endswith(suffix):
+                    base = name[: -len(suffix)]
+                    out["summary"].setdefault(base, {})[suffix[1:]] = v
+                    break
+    return out
+
+
+# -- host resource telemetry ----------------------------------------------
+
+
+def sample_resources() -> dict:
+    """One stdlib-only ``resource`` row body for this process."""
+    rss = 0
+    try:
+        with open("/proc/self/statm") as f:
+            rss = int(f.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        try:  # portable fallback: peak RSS, in KiB on Linux
+            import resource as _resource
+
+            rss = _resource.getrusage(
+                _resource.RUSAGE_SELF
+            ).ru_maxrss * 1024
+        except (ImportError, OSError):
+            rss = 0
+    times = os.times()
+    cpu = times.user + times.system
+    try:
+        fds = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        fds = 0
+    collections = sum(s.get("collections", 0) for s in gc.get_stats())
+    return resource_row(
+        rss_bytes=rss,
+        cpu_seconds=cpu,
+        threads=threading.active_count(),
+        open_fds=fds,
+        gc_collections=collections,
+    )
+
+
+class ResourceSampler:
+    """Periodic (or caller-paced) host resource sampling.
+
+    ``sample()`` emits one ``resource`` JSONL row through the metrics
+    logger and mirrors the values into ``obs.resource.*`` gauges so
+    the live ``/metrics`` exposition carries them too.  ``start()``
+    spawns a sampling thread for runs whose main thread is busy
+    training; the serve CLI instead calls ``sample()`` from its stats
+    tick — same row, no extra thread.  The thread emits one row
+    immediately (short runs still carry data) and one final row at
+    ``close()``, and is joined with a timeout (XF006)."""
+
+    def __init__(self, metrics_logger=None, registry=None,
+                 interval_s: float = 30.0):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        self.metrics_logger = metrics_logger
+        self.registry = registry
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def sample(self) -> dict:
+        body = sample_resources()
+        if self.metrics_logger is not None:
+            self.metrics_logger.log("resource", body)
+        if self.registry is not None:
+            for key in ("rss_bytes", "cpu_seconds", "threads",
+                        "open_fds", "gc_collections"):
+                self.registry.gauge_set(
+                    "obs.resource." + key, float(body[key])
+                )
+        return body
+
+    def _pulse(self) -> None:
+        # liveness watermark for the loop below (XF009 heartbeat
+        # surface): a wedged sampler shows as a stale beat gauge in
+        # the very exposition it feeds
+        if self.registry is not None:
+            self.registry.gauge_set(
+                "obs.resource.beat_unix", time.time()
+            )
+
+    def _run(self) -> None:
+        self.sample()
+        while not self._stop.wait(self.interval_s):
+            self._pulse()
+            self.sample()
+
+    def start(self) -> "ResourceSampler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="resource-sampler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Idempotent: stop the thread (joined with a timeout), then
+        emit one final sample while the metrics logger is still open."""
+        first = not self._stop.is_set()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if first:
+            self.sample()
+
+    def __enter__(self) -> "ResourceSampler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- standalone exporter (training/stream runs) ---------------------------
+
+
+class _ExporterHandler(BaseHTTPRequestHandler):
+    server_version = "xflow-exporter/1"
+    protocol_version = "HTTP/1.1"
+
+    def setup(self) -> None:
+        # same rationale as serve/server.py _Handler.setup: the class
+        # attribute `timeout` is None, so a scraper that stalls
+        # mid-request would pin this handler thread indefinitely
+        self.timeout = self.server.exporter.timeout_s  # type: ignore[attr-defined]
+        super().setup()
+
+    def log_message(self, fmt, *args) -> None:
+        pass  # a scrape is not stderr chatter
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler API)
+        exporter = self.server.exporter  # type: ignore[attr-defined]
+        try:
+            if self.path == "/metrics":
+                payload = exporter.render().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                code = 200
+            elif self.path == "/healthz":
+                payload = json.dumps({"status": "exporting"}).encode()
+                ctype = "application/json"
+                code = 200
+            else:
+                payload = b"not found: try /metrics\n"
+                ctype = "text/plain"
+                code = 404
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except (ConnectionError, TimeoutError, OSError):
+            pass  # scraper went away mid-response: its loss, not ours
+
+
+class _ExporterServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # bounded accept backlog; a scraper is never latency-critical
+    request_queue_size = 8
+
+
+class MetricsExporter:
+    """Standalone ``GET /metrics`` endpoint for runs that have no HTTP
+    surface of their own (training, stream driver).  One accept thread
+    (stdlib ``serve_forever``), per-connection socket deadlines
+    (``EXPORTER_TIMEOUT_S``), reaped via ``close()`` with a timed join
+    (XF006) — the Trainer owns the lifecycle when
+    ``Config.obs_export_port`` is set."""
+
+    def __init__(self, registry, host: str = "127.0.0.1", port: int = 0,
+                 timeout_s: float = EXPORTER_TIMEOUT_S, extra=None):
+        if timeout_s <= 0:
+            raise ValueError("timeout_s must be > 0")
+        self.registry = registry
+        self.timeout_s = timeout_s
+        # optional () -> str appended to the exposition (e.g. a serve
+        # tier pooling several registries)
+        self.extra = extra
+        self._httpd = _ExporterServer((host, port), _ExporterHandler)
+        self._httpd.exporter = self  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def render(self) -> str:
+        text = render_exposition(self.registry.snapshot(reset=False))
+        if self.extra is not None:
+            text += self.extra()
+        return text
+
+    def _serve(self) -> None:
+        # stdlib accept loop; poll_interval bounds shutdown latency
+        self._httpd.serve_forever(poll_interval=0.2)
+
+    def start(self) -> "MetricsExporter":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._serve, name="metrics-exporter", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._httpd.server_close()
+
+    def __enter__(self) -> "MetricsExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,382 @@
+"""Rolling-window SLO alerting + streaming doctor (ISSUE 19).
+
+Post-hoc diagnosis (`obs doctor`) answers "what went wrong" after a
+run dies; operating a fleet needs "what is going wrong" while it can
+still be fixed.  This module adds both halves:
+
+* ``AlertRule`` / ``AlertEvaluator`` — a declarative rolling-window
+  SLO evaluator with **multi-window burn-rate** semantics: a rule
+  fires only when its metric breaches the threshold over BOTH a short
+  window (the problem is happening *now* — fast resolve once it
+  stops) and a long window (it is *sustained* — one bad tick never
+  pages).  Transitions emit ``alert`` JSONL rows (firing/resolved,
+  obs/schema.py) that `obs doctor` consumes as first-class evidence
+  and ``GET /v1/stats`` summarizes.  The committed default rules
+  cover error fraction, shed fraction, queue p99, freshness age, and
+  input-stall fraction.
+
+* ``LiveTailer`` / ``run_live`` — `python -m xflow_tpu.obs live`:
+  incremental tailing of growing (multi-host, rank-tagged) metrics
+  files — torn tail fragments wait in the file, torn complete lines
+  are counted and skipped, never fatal — feeding the full doctor
+  check suite plus the alert rules continuously, printing each
+  finding the moment the evidence supports it.  On a finished file it
+  reaches exactly the diagnosis `obs doctor` reaches post-hoc
+  (scripts/check_live_obs.py pins this).
+
+docs/OBSERVABILITY.md "Operating a live fleet" documents the rule
+grammar and the burn-rate math.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from xflow_tpu.obs.schema import alert_row
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    """One declarative SLO rule: sample ``field`` (optionally divided
+    by ``denom``) from every row of ``kind``; fire when the mean over
+    both windows exceeds ``threshold``."""
+
+    name: str
+    kind: str
+    field: str
+    threshold: float
+    denom: str = ""
+    short_s: float = 60.0
+    long_s: float = 300.0
+    min_samples: int = 1
+    description: str = ""
+
+    def value(self, row: dict) -> float | None:
+        """The rule's sample from one row (None = row not sampled)."""
+        if row.get("kind") != self.kind:
+            return None
+        v = row.get(self.field)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        if self.denom:
+            d = row.get(self.denom)
+            if isinstance(d, bool) or not isinstance(d, (int, float)):
+                return None
+            if d <= 0:
+                return None
+            return float(v) / float(d)
+        return float(v)
+
+
+def default_rules(
+    short_s: float = 60.0, long_s: float = 300.0
+) -> tuple[AlertRule, ...]:
+    """The committed rule set (thresholds are operating bars, not CI
+    bars: a healthy tier under load stays silent on all five)."""
+    return (
+        AlertRule(
+            "serve_error_frac", "serve_shed", "errors",
+            threshold=0.05, denom="admitted",
+            short_s=short_s, long_s=long_s,
+            description="scoring errors per admitted request",
+        ),
+        AlertRule(
+            "serve_shed_frac", "serve_shed", "shed_frac",
+            threshold=0.5,
+            short_s=short_s, long_s=long_s,
+            description="admission-control shed fraction (a storm, "
+            "not policy shedding)",
+        ),
+        AlertRule(
+            "serve_queue_p99", "serve_stats", "queue_p99",
+            threshold=1.0,
+            short_s=short_s, long_s=long_s,
+            description="p99 coalescing-queue wait in seconds",
+        ),
+        AlertRule(
+            "freshness_age", "freshness", "newest_event_age_s",
+            threshold=1.0, denom="slo_s",
+            short_s=short_s, long_s=long_s,
+            description="event-to-servable age as a fraction of the "
+            "freshness SLO",
+        ),
+        AlertRule(
+            "train_stall_frac", "train_epoch", "input_stall_frac",
+            threshold=0.9,
+            short_s=short_s, long_s=long_s,
+            description="epoch wall fraction spent stalled on input",
+        ),
+    )
+
+
+def _mean(samples: list[float]) -> float:
+    return sum(samples) / len(samples)
+
+
+class AlertEvaluator:
+    """Feed rows in, get ``alert`` transitions out.
+
+    Samples are timestamped from the row's ``time_unix`` tag when
+    present (merged/tailed multi-host streams evaluate in LOG time, so
+    live and post-hoc reach the same verdicts) and from the caller's
+    ``now`` otherwise (in-process serve ticks).  When a metrics logger
+    is attached, every transition is also emitted as an ``alert``
+    JSONL row.  All state is lock-guarded: the serve CLI evaluates on
+    its stats tick while HTTP handler threads read ``summary()``."""
+
+    def __init__(self, rules=None, metrics_logger=None):
+        self.rules: tuple[AlertRule, ...] = (
+            tuple(rules) if rules is not None else default_rules()
+        )
+        names = [r.name for r in self.rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate rule names: {names}")
+        self.metrics_logger = metrics_logger
+        self._lock = threading.Lock()
+        self._samples: dict[str, deque] = {
+            r.name: deque() for r in self.rules
+        }
+        self._firing: dict[str, dict] = {}
+        self._fired_total = 0
+        self._resolved_total = 0
+        self._last: dict | None = None
+
+    def observe_rows(self, rows, now: float | None = None) -> list[dict]:
+        """Ingest rows, evaluate every rule, return (and log) the
+        ``alert`` rows for any state transitions."""
+        if now is None:
+            stamps = [
+                r.get("time_unix") for r in rows
+                if isinstance(r.get("time_unix"), (int, float))
+            ]
+            now = max(stamps) if stamps else time.time()
+        with self._lock:
+            for row in rows:
+                ts = row.get("time_unix")
+                if isinstance(ts, bool) or not isinstance(
+                    ts, (int, float)
+                ):
+                    ts = now
+                for rule in self.rules:
+                    v = rule.value(row)
+                    if v is not None:
+                        self._samples[rule.name].append((float(ts), v))
+            transitions = self._evaluate_locked(now)
+        if self.metrics_logger is not None:
+            for body in transitions:
+                self.metrics_logger.log("alert", body)
+        # callers without a logger (obs live) still need kind-tagged
+        # rows to feed diagnose()
+        return [dict(b, kind="alert", t=0.0) for b in transitions]
+
+    def _evaluate_locked(self, now: float) -> list[dict]:
+        out: list[dict] = []
+        for rule in self.rules:
+            samples = self._samples[rule.name]
+            while samples and samples[0][0] < now - rule.long_s:
+                samples.popleft()
+            short = [
+                v for ts, v in samples if ts >= now - rule.short_s
+            ]
+            if len(short) < rule.min_samples:
+                continue  # no short-window evidence either way
+            short_mean = _mean(short)
+            long_mean = _mean([v for _, v in samples])
+            firing = rule.name in self._firing
+            if not firing and (
+                short_mean > rule.threshold
+                and long_mean > rule.threshold
+            ):
+                body = alert_row(
+                    rule=rule.name, state="firing",
+                    value=short_mean, threshold=rule.threshold,
+                    short_s=rule.short_s, long_s=rule.long_s,
+                    samples=len(short),
+                    detail=(
+                        f"{rule.kind}.{rule.field} short-window mean "
+                        f"{short_mean:.4f} and long-window mean "
+                        f"{long_mean:.4f} both over "
+                        f"{rule.threshold} — {rule.description}"
+                    ),
+                )
+                self._firing[rule.name] = body
+                self._fired_total += 1
+                self._last = body
+                out.append(body)
+            elif firing and short_mean <= rule.threshold:
+                body = alert_row(
+                    rule=rule.name, state="resolved",
+                    value=short_mean, threshold=rule.threshold,
+                    short_s=rule.short_s, long_s=rule.long_s,
+                    samples=len(short),
+                    detail=(
+                        f"{rule.kind}.{rule.field} short-window mean "
+                        f"{short_mean:.4f} back under "
+                        f"{rule.threshold}"
+                    ),
+                )
+                del self._firing[rule.name]
+                self._resolved_total += 1
+                self._last = body
+                out.append(body)
+        return out
+
+    def summary(self) -> dict:
+        """JSON-ready state for ``GET /v1/stats``: which rules are
+        firing right now plus lifetime transition counts."""
+        with self._lock:
+            return {
+                "firing": sorted(self._firing),
+                "fired_total": self._fired_total,
+                "resolved_total": self._resolved_total,
+                "last": dict(self._last) if self._last else None,
+            }
+
+
+# -- incremental tailing ----------------------------------------------------
+
+
+class _FileCursor:
+    __slots__ = ("offset", "rank", "run_id", "t0")
+
+    def __init__(self):
+        self.offset = 0
+        self.rank = 0
+        self.run_id = ""
+        self.t0 = 0.0
+
+
+class LiveTailer:
+    """Incremental, rank-tagging reader over growing metrics files.
+
+    Each ``poll()`` consumes only the bytes appended since the last
+    one, up to the final newline — a torn tail fragment simply stays
+    in the file until the writer finishes the line.  A COMPLETE line
+    that fails to parse (a crashed writer's garbage) is counted in
+    ``skipped`` and skipped: a live monitor must outlive the thing it
+    monitors.  Rows are tagged with rank / run_id / time_unix exactly
+    like ``doctor.merge_rows``, so downstream checks see the same
+    stream either way."""
+
+    def __init__(self, paths):
+        self.paths = [os.fspath(p) for p in paths]
+        self.skipped = 0
+        self._cursors = {p: _FileCursor() for p in self.paths}
+
+    def poll(self) -> list[dict]:
+        """Newly completed rows across every file, time-sorted."""
+        out: list[dict] = []
+        for path in self.paths:
+            cur = self._cursors[path]
+            try:
+                with open(path, "rb") as f:
+                    f.seek(cur.offset)
+                    chunk = f.read()
+            except OSError:
+                continue  # not created yet / rotated away: keep tailing
+            end = chunk.rfind(b"\n")
+            if end < 0:
+                continue  # torn tail only — wait for the newline
+            cur.offset += end + 1
+            for raw in chunk[: end + 1].split(b"\n"):
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    row = json.loads(raw)
+                except ValueError:
+                    self.skipped += 1
+                    continue
+                if row.get("kind") == "run_start":
+                    cur.rank = int(row.get("rank", 0))
+                    cur.run_id = str(row.get("run_id", ""))
+                    cur.t0 = float(row.get("time_unix", 0.0))
+                tagged = dict(row)
+                tagged.setdefault("rank", cur.rank)
+                tagged.setdefault("run_id", cur.run_id)
+                tagged.setdefault(
+                    "time_unix",
+                    round(cur.t0 + float(row.get("t", 0.0)), 3),
+                )
+                out.append(tagged)
+        out.sort(key=lambda r: r.get("time_unix", 0.0))
+        return out
+
+
+def run_live(
+    paths,
+    out=print,
+    interval_s: float = 2.0,
+    max_seconds: float = 0.0,
+    once: bool = False,
+    rules=None,
+    sleep=time.sleep,
+) -> int:
+    """The `obs live` engine: tail ``paths``, run the alert rules and
+    the full doctor suite over everything seen so far, and print each
+    finding / alert transition once, the moment it appears.  Runs
+    until ``max_seconds`` (0 = until interrupted) or a single pass
+    with ``once``.  Exit code matches `obs doctor`: 1 when anything
+    at warn or above fired, else 0."""
+    from xflow_tpu.obs.doctor import diagnose
+
+    tailer = LiveTailer(paths)
+    evaluator = AlertEvaluator(rules=rules)
+    rows: list[dict] = []
+    reported: set[tuple] = set()
+    seen_skipped = 0
+    bad = False
+    deadline = time.monotonic() + (
+        max_seconds if max_seconds > 0 else float("inf")
+    )
+    try:
+        while time.monotonic() < deadline:
+            new = tailer.poll()
+            if new:
+                alerts = evaluator.observe_rows(new)
+                rows.extend(new)
+                rows.extend(alerts)
+                for a in alerts:
+                    out(
+                        f"[ALERT] {a['rule']} {a['state']}: "
+                        f"value {a['value']} vs threshold "
+                        f"{a['threshold']} ({a['detail']})"
+                    )
+                findings = diagnose(rows)
+                for d in findings:
+                    key = (d.severity, d.code, d.message)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    if d.severity in ("crit", "warn"):
+                        bad = True
+                    out(
+                        f"[{d.severity.upper():4s}] {d.code}: "
+                        f"{d.message}"
+                    )
+            if tailer.skipped > seen_skipped:
+                out(
+                    f"(skipped {tailer.skipped - seen_skipped} "
+                    "unparseable line(s) — still-growing file)"
+                )
+                seen_skipped = tailer.skipped
+            if once:
+                break
+            sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+    summary = evaluator.summary()
+    out(
+        f"obs live — {len(rows)} row(s) observed, "
+        f"{summary['fired_total']} alert(s) fired, "
+        f"{summary['resolved_total']} resolved, "
+        f"firing now: {summary['firing'] or 'none'}"
+    )
+    if summary["firing"]:
+        bad = True
+    return 1 if bad else 0

@@ -331,6 +331,39 @@ SCHEMA: dict[str, dict[str, Any]] = {
         "reason": str,
         "active_phase": str,
     },
+    # -- live telemetry plane (obs/live.py, obs/export.py;
+    # docs/OBSERVABILITY.md "Operating a live fleet") ----------------------
+    # one per SLO alert transition (obs/live.py AlertEvaluator): state
+    # is firing (both the short AND the long burn-rate window breached
+    # the rule's threshold) or resolved (the short window dropped back
+    # under it).  value is the short-window mean at transition time;
+    # `obs doctor` treats these rows as first-class evidence.
+    "alert": {
+        "t": (int, float),
+        "kind": str,
+        "rule": str,
+        "state": str,
+        "value": (int, float),
+        "threshold": (int, float),
+        "short_s": (int, float),
+        "long_s": (int, float),
+        "samples": int,
+        "detail": str,
+    },
+    # one per host resource sample (obs/export.py ResourceSampler):
+    # stdlib-only process telemetry — RSS, cumulative CPU seconds,
+    # live thread count, open file descriptors, cumulative GC
+    # collections — so a leak or a CPU-bound straggler shows up in the
+    # same stream as the metrics it distorts.
+    "resource": {
+        "t": (int, float),
+        "kind": str,
+        "rss_bytes": int,
+        "cpu_seconds": (int, float),
+        "threads": int,
+        "open_fds": int,
+        "gc_collections": int,
+    },
 }
 
 
@@ -426,6 +459,47 @@ def health_row(
     }
 
 
+def alert_row(
+    rule: str,
+    state: str,
+    value: float,
+    threshold: float,
+    short_s: float,
+    long_s: float,
+    samples: int,
+    detail: str,
+) -> dict:
+    """A schema-complete ``alert`` record body (health_row discipline:
+    every emitter builds the row here)."""
+    return {
+        "rule": rule,
+        "state": state,
+        "value": round(float(value), 6),
+        "threshold": round(float(threshold), 6),
+        "short_s": round(float(short_s), 3),
+        "long_s": round(float(long_s), 3),
+        "samples": int(samples),
+        "detail": detail,
+    }
+
+
+def resource_row(
+    rss_bytes: int,
+    cpu_seconds: float,
+    threads: int,
+    open_fds: int,
+    gc_collections: int,
+) -> dict:
+    """A schema-complete ``resource`` record body."""
+    return {
+        "rss_bytes": int(rss_bytes),
+        "cpu_seconds": round(float(cpu_seconds), 3),
+        "threads": int(threads),
+        "open_fds": int(open_fds),
+        "gc_collections": int(gc_collections),
+    }
+
+
 def validate_row(row: dict, lineno: int | None = None) -> list[str]:
     """Schema errors for one parsed JSONL row ([] = valid)."""
     where = f"line {lineno}: " if lineno is not None else ""
@@ -474,3 +548,30 @@ def load_jsonl(path: str) -> list[dict]:
             except ValueError as e:
                 raise ValueError(f"{path}:{i}: not valid JSON: {e}")
     return rows
+
+
+def load_jsonl_tolerant(path: str) -> tuple[list[dict], int]:
+    """Parse a metrics file that may still be APPENDED to: a torn
+    FINAL line (the writer is mid-``write``, or the file was copied
+    mid-line) is skipped and counted instead of raising.  A malformed
+    line anywhere else is still corruption and raises exactly like
+    ``load_jsonl`` — torn tails are expected on live files, torn
+    middles are not.  Returns ``(rows, skipped)`` with skipped in
+    {0, 1}."""
+    with open(path) as f:
+        lines = f.readlines()
+    rows: list[dict] = []
+    skipped = 0
+    last = len(lines)
+    for i, line in enumerate(lines, 1):
+        stripped = line.strip()
+        if not stripped:
+            continue
+        try:
+            rows.append(json.loads(stripped))
+        except ValueError as e:
+            if i == last:
+                skipped = 1
+                break
+            raise ValueError(f"{path}:{i}: not valid JSON: {e}")
+    return rows, skipped

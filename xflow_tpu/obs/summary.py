@@ -190,6 +190,36 @@ def format_run(run: Run) -> str:
             f"{last.get('rows', 0)} row(s))"
         )
         out.append(line)
+    alerts = run.kind("alert")
+    if alerts:
+        state: dict[str, str] = {}
+        for a in alerts:
+            state[str(a.get("rule", "?"))] = str(a.get("state", "?"))
+        open_rules = sorted(r for r, s in state.items() if s == "firing")
+        fired = sum(1 for a in alerts if a.get("state") == "firing")
+        resolved = sum(1 for a in alerts if a.get("state") == "resolved")
+        last = alerts[-1]
+        out.append(
+            f"alerts: {fired} fired, {resolved} resolved; "
+            f"firing at end: {', '.join(open_rules) or 'none'}; "
+            f"last: {last.get('rule')} {last.get('state')} "
+            f"(value {last.get('value')} vs threshold "
+            f"{last.get('threshold')}; docs/OBSERVABILITY.md "
+            "\"Operating a live fleet\")"
+        )
+    res = run.kind("resource")
+    if res:
+        last = res[-1]
+        peak_rss = max(int(r.get("rss_bytes", 0)) for r in res)
+        out.append(
+            f"resources: {len(res)} sample(s), rss last/peak = "
+            f"{float(last.get('rss_bytes', 0)) / 2**20:.1f}/"
+            f"{peak_rss / 2**20:.1f} MiB, "
+            f"cpu {float(last.get('cpu_seconds', 0.0)):.1f}s, "
+            f"{last.get('threads', 0)} thread(s), "
+            f"{last.get('open_fds', 0)} open fd(s), "
+            f"{last.get('gc_collections', 0)} gc collection(s)"
+        )
     traces = [
         r for r in run.kind("reqtrace")
         if r.get("span") == "request"

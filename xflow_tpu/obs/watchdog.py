@@ -97,6 +97,7 @@ class Watchdog:
         self._pending: dict[str, Callable[[], bool]] = {}
         self.trip_count = 0
         self.dump_count = 0
+        self._last_row: dict[str, Any] | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -224,7 +225,34 @@ class Watchdog:
         )
         if self.metrics_logger is not None:
             self.metrics_logger.log("health", row)
+        with self._lock:
+            self._last_row = row
         return row
+
+    def state(self) -> dict:
+        """JSON-ready live health view — the ``GET /v1/stats``
+        enrichment (serve/server.py): open incidents per channel,
+        lifetime trip/dump counts, and the last health row emitted,
+        so one scrape answers "is this tier sick" without reading the
+        metrics file."""
+        with self._lock:
+            incidents = {
+                ch: {
+                    "cause": inc["cause"],
+                    "threshold_seconds": round(inc["threshold"], 3),
+                    "worst_silence_seconds": round(inc["worst_age"], 3),
+                    "dumped": inc["dumped"],
+                }
+                for ch, inc in self._incidents.items()
+            }
+            last = dict(self._last_row) if self._last_row else None
+            return {
+                "healthy": not incidents,
+                "incidents": incidents,
+                "trip_count": self.trip_count,
+                "dump_count": self.dump_count,
+                "last": last,
+            }
 
     def _trip(
         self, channel: str, cause: str, threshold: float, age: float

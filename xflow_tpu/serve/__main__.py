@@ -279,6 +279,19 @@ def cmd_serve(args) -> int:
     )
     wd.set_pending("serve", fleet.pending)
     wd.set_pending("http", lambda: tier.running)
+    # live telemetry plane (obs/live.py, obs/export.py): SLO alert
+    # rules evaluated over each stats window, host resource rows per
+    # tick, and both surfaced on GET /v1/stats next to the watchdog's
+    # health state; GET /metrics exposition comes free with the tier
+    from xflow_tpu.obs.export import ResourceSampler
+    from xflow_tpu.obs.live import AlertEvaluator
+
+    alerts = AlertEvaluator(metrics_logger=logger)
+    sampler = ResourceSampler(
+        metrics_logger=logger, registry=fleet.registry
+    )
+    tier.watchdog = wd
+    tier.alerts = alerts
 
     stop = threading.Event()
 
@@ -299,7 +312,12 @@ def cmd_serve(args) -> int:
     }, sort_keys=True), flush=True)
     # stats-window loop IS the main thread's job until a drain signal
     while not stop.wait(args.stats_every_s):
-        fleet.emit_stats()
+        out = fleet.emit_stats()
+        sampler.sample()
+        alerts.observe_rows([
+            dict(out["stats"], kind="serve_stats"),
+            dict(out["shed"], kind="serve_shed"),
+        ])
     wd.stop()
     final = tier.close()
     if logger is not None:

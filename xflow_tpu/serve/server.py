@@ -414,7 +414,25 @@ class _Handler(BaseHTTPRequestHandler):
                 doc = self.tier.fleet.stats()
                 if self.tier.cascade is not None:
                     doc["cascade"] = self.tier.cascade.stats()
+                if self.tier.watchdog is not None:
+                    doc["watchdog"] = self.tier.watchdog.state()
+                if self.tier.alerts is not None:
+                    doc["alerts"] = self.tier.alerts.summary()
                 self._json(200, doc)
+            elif self.path == "/metrics":
+                # Prometheus text exposition rendered from a lock-safe
+                # registry snapshot (obs/export.py) — non-destructive,
+                # so scraping never perturbs the stats-window counters
+                from xflow_tpu.obs.export import render_exposition
+
+                text = render_exposition(
+                    self.tier.fleet.registry.snapshot(reset=False)
+                )
+                self._respond(
+                    200,
+                    text.encode(),
+                    ctype="text/plain; version=0.0.4; charset=utf-8",
+                )
             else:
                 self._json(404, {"error": f"no such path {self.path}"})
         except ConnectionError:
@@ -642,6 +660,12 @@ class ServeTier:
         # way mixed production traffic would.
         self.cascade = cascade
         self.flight = flight
+        # optional live-telemetry attachments (serve CLI wires these):
+        # a Watchdog whose .state() and an AlertEvaluator whose
+        # .summary() enrich GET /v1/stats — set once before start(),
+        # read-only from handler threads thereafter
+        self.watchdog = None
+        self.alerts = None
         self.default_canary_frac = default_canary_frac
         # survived serve.accept failpoint fires (written only from the
         # accept loop, read by tests/the chaos gate after close)

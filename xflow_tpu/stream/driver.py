@@ -47,6 +47,7 @@ import numpy as np
 import jax
 
 from xflow_tpu.config import Config
+from xflow_tpu.obs.live import AlertEvaluator
 from xflow_tpu.serve.artifact import export_artifact, servable_digest
 from xflow_tpu.serve.fleet import ReplicaFleet, ShedError
 from xflow_tpu.stream.delta import (
@@ -172,6 +173,13 @@ class StreamDriver:
         # close() shuts it down explicitly instead of waiting on GC
         # (the Trainer._live_prefetch discipline, generator edition)
         self._stream_gen = None
+        # SLO alert rules over the driver's own freshness rows
+        # (obs/live.py): a stale servable fires `freshness_age` into
+        # the same metrics stream the doctor reads — the driver is
+        # single-threaded, so evaluation rides the commit path inline
+        self.alerts = AlertEvaluator(
+            metrics_logger=self.trainer.metrics_logger
+        )
         # test/gate hook: called as on_commit(driver, export_info)
         # right after a rollout commits, while the trainer state still
         # sits at the committed step — the parity check's window
@@ -386,12 +394,10 @@ class StreamDriver:
         latency an advertiser's newest click waited to influence live
         scores."""
         logger = self.trainer.metrics_logger
-        if logger is None:
-            return
         age = max(0.0, time.time() - info["newest_ingest"]) if (
             info["newest_ingest"] > 0
         ) else 0.0
-        logger.log("freshness", {
+        row = {
             "event": event,
             "newest_event_age_s": round(age, 3),
             "slo_s": round(self.freshness_slo_s, 3),
@@ -403,7 +409,12 @@ class StreamDriver:
             "rows": int(info["rows"]),
             "delta_bytes": int(info["bytes"]),
             "deltas_since_base": int(info["deltas_since_base"]),
-        })
+        }
+        if logger is not None:
+            logger.log("freshness", row)
+        # the freshness_age burn-rate rule sees every row, logger or
+        # not — firing/resolved transitions land as `alert` rows
+        self.alerts.observe_rows([dict(row, kind="freshness")])
 
     # -- the loop -----------------------------------------------------------
 

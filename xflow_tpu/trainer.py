@@ -214,6 +214,36 @@ class Trainer:
                 log=self._log,
             )
             self._watchdog.start()
+        # Live telemetry plane (obs/export.py, docs/OBSERVABILITY.md
+        # "Operating a live fleet"): a host resource sampler emitting
+        # `resource` rows into this run's metrics stream, and a
+        # standalone /metrics exposition endpoint over the live
+        # registry for runs with no HTTP surface of their own.  Both
+        # are reaped by close() before the metrics logger shuts.
+        self._resource_sampler = None
+        self._exporter = None
+        if cfg.obs_resource_every_s > 0 and self.metrics_logger is not None:
+            from xflow_tpu.obs.export import ResourceSampler
+
+            self._resource_sampler = ResourceSampler(
+                metrics_logger=self.metrics_logger,
+                registry=self.obs.registry if self.obs.enabled else None,
+                interval_s=cfg.obs_resource_every_s,
+            )
+            self._resource_sampler.start()
+        if cfg.obs_export_port:
+            from xflow_tpu.obs.export import MetricsExporter
+
+            # rank offsets the port so N single-box trainers coexist
+            self._exporter = MetricsExporter(
+                self.obs.registry,
+                port=cfg.obs_export_port + self.host,
+            )
+            self._exporter.start()
+            self._log(
+                f"metrics exporter serving {self._exporter.address}"
+                "/metrics"
+            )
         # Lock-order sanitizer (analysis/sanitizer.py): when armed —
         # Config flag or XFLOW_LOCK_SANITIZER env — the obs-stack locks
         # are swapped for instrumented wrappers so real acquisition
@@ -317,6 +347,12 @@ class Trainer:
         call this) to cover every other exit."""
         if self._watchdog is not None:
             self._watchdog.stop()
+        if self._resource_sampler is not None:
+            # joins the sampler thread and emits the final resource
+            # row — must precede metrics_logger.close() below
+            self._resource_sampler.close()
+        if self._exporter is not None:
+            self._exporter.close()
         for gen in list(self._live_transfer):
             # GeneratorExit at the suspended yield -> _transfer_ahead's
             # abandon path -> shutdown(wait=False, cancel_futures=True):
