@@ -22,7 +22,17 @@ a verdict:
 * ``outstanding`` (admitted requests the tier never resolved before
   the loadgen drain timeout) must not exceed ``--max-outstanding``
   (default 0: a black-holed request is neither an error nor a shed
-  and must not pass silently).
+  and must not pass silently);
+* ``--compare-transports`` switches to two-leg mode: the newest
+  ``transport == "binary"`` row is judged against every gate above AND
+  must beat the newest ``transport == "http"`` row's achieved QPS by
+  ``--min-transport-ratio`` with a p99 no worse — a binary transport
+  that is not faster than HTTP on the same fleet is a regression, not
+  a feature;
+* ``--qos-ordering`` asserts the admission-control shed ORDER on the
+  judged row: ``bidding`` must shed nothing, and any shedding at all
+  must include ``best_effort`` — overload is supposed to land on the
+  class that bid for it.
 
 The metrics file must pass obs/schema.py validation first — a gate
 that reads torn rows gates nothing.  The NEWEST ``serve_bench`` row is
@@ -76,6 +86,23 @@ def main(argv: list[str] | None = None) -> int:
         "timed out (default 0: a black-holed request is neither an "
         "error nor a shed and must not pass silently)",
     )
+    p.add_argument(
+        "--compare-transports", action="store_true",
+        help="two-leg mode: judge the newest transport=binary row "
+        "(all standard gates) and require it to beat the newest "
+        "transport=http row on achieved QPS with a p99 no worse",
+    )
+    p.add_argument(
+        "--min-transport-ratio", type=float, default=1.0,
+        help="with --compare-transports: min binary/http achieved-QPS "
+        "ratio (default 1.0 — binary must at least match HTTP)",
+    )
+    p.add_argument(
+        "--qos-ordering", action="store_true",
+        help="assert shed order on the judged row: bidding sheds "
+        "nothing and any shedding includes best_effort (row must "
+        "carry qos_shed — run loadgen with --qos-mix)",
+    )
     args = p.parse_args(argv)
 
     from xflow_tpu.obs.schema import load_jsonl, validate_rows
@@ -99,7 +126,26 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return 2
-    row = bench[-1]
+    http_row = None
+    if args.compare_transports:
+        by = {"binary": None, "http": None}
+        for r in bench:  # newest of each transport wins
+            t = r.get("transport")
+            if t in by:
+                by[t] = r
+        missing = [t for t, r in by.items() if r is None]
+        if missing:
+            print(
+                "FAIL: --compare-transports needs one serve_bench row "
+                f"per transport; missing {missing} in {args.metrics} "
+                "(run loadgen once with --binary-addr and once with "
+                "--url against the same server)",
+                file=sys.stderr,
+            )
+            return 2
+        row, http_row = by["binary"], by["http"]
+    else:
+        row = bench[-1]
     if "offered_qps_actual" not in row:
         print(
             "FAIL: newest serve_bench row carries no offered_qps_actual "
@@ -157,6 +203,48 @@ def main(argv: list[str] | None = None) -> int:
             achieved_frac >= args.min_achieved_frac,
             f"{achieved_frac:.3f} (min {args.min_achieved_frac}, "
             f"{row.get('achieved_qps')} of {offered} qps)",
+        ))
+    if http_row is not None:
+        bin_qps = float(row.get("achieved_qps", 0.0))
+        http_qps = float(http_row.get("achieved_qps", 0.0))
+        ratio = bin_qps / http_qps if http_qps else float("inf")
+        checks.append((
+            "transport_qps",
+            ratio >= args.min_transport_ratio,
+            f"binary {bin_qps} vs http {http_qps} qps achieved "
+            f"({ratio:.2f}x, min {args.min_transport_ratio}x)",
+        ))
+        bin_p99 = 1e3 * float(row.get("e2e_p99", 0.0))
+        http_p99 = 1e3 * float(http_row.get("e2e_p99", 0.0))
+        checks.append((
+            "transport_p99",
+            bin_p99 <= http_p99,
+            f"binary {bin_p99:.1f}ms vs http {http_p99:.1f}ms "
+            "(binary must be no worse)",
+        ))
+    if args.qos_ordering:
+        qshed = row.get("qos_shed")
+        if not isinstance(qshed, dict):
+            print(
+                "FAIL: --qos-ordering needs a qos_shed map on the "
+                "judged serve_bench row — run loadgen with --qos-mix",
+                file=sys.stderr,
+            )
+            return 2
+        bidding = int(qshed.get("bidding", 0))
+        best_effort = int(qshed.get("best_effort", 0))
+        total = sum(int(v) for v in qshed.values())
+        checks.append((
+            "qos_bidding_shed",
+            bidding == 0,
+            f"{bidding} bidding request(s) shed (must be 0: the top "
+            "class is the last to go)",
+        ))
+        checks.append((
+            "qos_shed_order",
+            total == 0 or best_effort > 0,
+            f"{total} total shed, {best_effort} from best_effort "
+            "(any shedding must include the lowest class)",
         ))
 
     failed = 0

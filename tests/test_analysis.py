@@ -1693,7 +1693,8 @@ def test_wirefuzz_deterministic_and_clean():
     assert a["mutation_digest"] == b["mutation_digest"]
     assert a["ok"] and b["ok"], (a, b)
     assert set(a["targets"]) == {
-        "xfs1", "xfs2", "packed_v2", "binary_csr", "delta_manifest"
+        "xfs1", "xfs2", "xfb1", "packed_v2", "binary_csr",
+        "delta_manifest",
     }
     for name, t in a["targets"].items():
         c = t["counts"]

@@ -1027,7 +1027,9 @@ def test_http_target_honors_retry_after(monkeypatch):
     posts = []
     monkeypatch.setattr(
         target, "_post",
-        lambda path, body: posts.append(path) or responses[len(posts) - 1],
+        lambda path, body, headers=None: (
+            posts.append(path) or responses[len(posts) - 1]
+        ),
     )
     fut = target.submit(np.asarray([1, 2, 3]))
     assert fut.result(0) == pytest.approx(0.25)
@@ -1037,7 +1039,8 @@ def test_http_target_honors_retry_after(monkeypatch):
     # all-429: retries exhaust into the typed shed, counted per retry
     target2 = HttpTarget("http://127.0.0.1:1", max_retries=1)
     monkeypatch.setattr(
-        target2, "_post", lambda path, body: (429, shed_body, "0.001")
+        target2, "_post",
+        lambda path, body, headers=None: (429, shed_body, "0.001"),
     )
     with pytest.raises(ShedError) as ei:
         target2.submit(np.asarray([1]))

@@ -212,6 +212,45 @@ def _make_xfs_targets() -> list[FuzzTarget]:
     ]
 
 
+def _make_xfb1_target() -> FuzzTarget:
+    """Structure-aware target for the pipelined XFB1 binary frames
+    (serve/binary.py): the seed blob is a THREE-frame stream (mixed
+    QoS bytes, mixed XFS1/XFS2 bodies, a u64-max request id), so
+    truncation, length inflation, magic confusion, and garbage hit
+    mid-pipeline frame boundaries, not just the stream head — exactly
+    the stream positions the live selector loop parses from."""
+    from xflow_tpu.obs.reqtrace import TraceContext
+    from xflow_tpu.serve.binary import (
+        decode_request_stream,
+        encode_frame,
+    )
+    from xflow_tpu.serve.server import encode_packed_request
+
+    plain = encode_packed_request(_xfs_rows())
+    traced = encode_packed_request(
+        _xfs_rows(),
+        trace=TraceContext(0x0F1E_2D3C_4B5A_6978, 3, True),
+    )
+    blob = (
+        encode_frame(1, "bidding", plain)
+        + encode_frame(2, "best_effort", traced)
+        + encode_frame(0xFFFF_FFFF_FFFF_FFFF, "normal", plain)
+    )
+
+    def decode(mutant: bytes):
+        return decode_request_stream(mutant)
+
+    def reencode(mutant: bytes) -> bytes:
+        out = b""
+        for rid, qos, rows, trace in decode_request_stream(mutant):
+            out += encode_frame(
+                rid, qos, encode_packed_request(rows, trace)
+            )
+        return out
+
+    return FuzzTarget("xfb1", blob, decode, reencode)
+
+
 def _make_packed_v2_target(workdir: str) -> FuzzTarget:
     from xflow_tpu.io import packed
     from xflow_tpu.io.batch import make_batch
@@ -321,6 +360,7 @@ def build_targets(workdir: str) -> list[FuzzTarget]:
     """One FuzzTarget per wire decoder, each seeded with a valid blob."""
     return [
         *_make_xfs_targets(),
+        _make_xfb1_target(),
         _make_packed_v2_target(workdir),
         _make_binary_csr_target(),
         _make_delta_target(workdir),
@@ -340,9 +380,10 @@ def fuzz_target(
     per-target report.  ``sha`` (when given) absorbs every mutant for
     the run-level determinism digest."""
     from xflow_tpu.io import binary, packed
+    from xflow_tpu.serve.binary import FRAME_MAGIC as XFB1_MAGIC
     from xflow_tpu.serve.server import PACKED_MAGIC, PACKED_TRACE_MAGIC
 
-    magics = [PACKED_MAGIC, PACKED_TRACE_MAGIC, binary.MAGIC, packed.MAGIC]
+    magics = [PACKED_MAGIC, PACKED_TRACE_MAGIC, XFB1_MAGIC, binary.MAGIC, packed.MAGIC]
     magics = [m for m in magics if not target.blob.startswith(m)]
     # the pristine blob must decode — a broken builder would make every
     # "typed error" below meaningless

@@ -230,6 +230,23 @@ class Config:
     # (serve/loadgen.py::HttpTarget → http.client.HTTPConnection
     # timeout=): bounds connect + each socket op against a wedged tier.
     serve_client_timeout_s: float = 30.0
+    # -- QoS-classed admission (serve/fleet.py QOS_CLASSES) --
+    # Each request carries a class (bidding/normal/best_effort — the
+    # XFB1 frame byte, the X-XFlow-QoS header, or the fleet default).
+    # All classes share one queue; lower classes see SCALED admission
+    # budgets, so under pressure best_effort sheds first and bidding
+    # last.  These fractions scale the fleet's deadline/depth budgets
+    # per class (bidding always gets the full budget).
+    serve_qos_normal_frac: float = 0.75
+    serve_qos_best_effort_frac: float = 0.45
+    # Hot-key score cache capacity in entries (serve/scache.py);
+    # 0 disables the cache.  Keyed by (servable_digest, row bytes),
+    # evicted atomically on rollout commit/delta — see SERVING.md.
+    serve_cache_capacity: int = 0
+    # Client-side pipelining depth per connection for the binary
+    # transport (serve/loadgen.py::BinaryTarget): max in-flight XFB1
+    # frames before the sender blocks.
+    serve_pipeline_depth: int = 32
 
     # -- host data path --
     # Use the native C++ parser (xflow_tpu/native) when a toolchain is
@@ -611,6 +628,24 @@ class Config:
                     f"{knob} must be > 0 (an unbounded serve-path wait "
                     "is exactly what analysis rule XF017 forbids)"
                 )
+        if not (
+            0.0
+            < self.serve_qos_best_effort_frac
+            <= self.serve_qos_normal_frac
+            <= 1.0
+        ):
+            raise ValueError(
+                "QoS budget fractions must satisfy 0 < "
+                "serve_qos_best_effort_frac <= serve_qos_normal_frac "
+                "<= 1 (best_effort sheds first, bidding last)"
+            )
+        if self.serve_cache_capacity < 0:
+            raise ValueError(
+                "serve_cache_capacity must be >= 0 (0 disables the "
+                "score cache)"
+            )
+        if self.serve_pipeline_depth < 1:
+            raise ValueError("serve_pipeline_depth must be >= 1")
         if self.checkpoint_keep < 0:
             raise ValueError("checkpoint_keep must be >= 0")
         if self.transfer_ahead_depth < 1:

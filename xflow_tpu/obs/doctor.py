@@ -553,6 +553,115 @@ def _check_serve(
     return out
 
 
+def _check_qos(rows: list[dict]) -> list[Diagnosis]:
+    """QoS admission ordering from ``serve_shed`` rows that carry the
+    per-class ``by_class`` split (serve/fleet.py QOS_CLASSES).
+
+    * **qos_inversion** — a window where the BIDDING class shed
+      traffic while best_effort shed nothing: the per-class budgets
+      are supposed to make best_effort absorb pressure first and the
+      bidding path shed last, so this ordering is inverted — the
+      class budget fractions are misconfigured (best_effort's budget
+      is not strictly tighter) or requests are mislabeled."""
+    inverted = []
+    for r in rows:
+        if r.get("kind") != "serve_shed":
+            continue
+        by_class = r.get("by_class") or {}
+        bid = by_class.get("bidding") or {}
+        be = by_class.get("best_effort") or {}
+        if (
+            int(bid.get("shed", 0)) > 0
+            and int(be.get("shed", 0)) == 0
+            # only meaningful when best_effort traffic was offered at
+            # all: an all-bidding workload shedding is plain overload
+            and int(be.get("admitted", 0)) + int(be.get("shed", 0)) > 0
+        ):
+            inverted.append(r)
+    if not inverted:
+        return []
+    r = inverted[-1]
+    bid = (r.get("by_class") or {}).get("bidding") or {}
+    return [Diagnosis(
+        "warn",
+        "qos_inversion",
+        f"QoS inversion in {len(inverted)} stats window(s): the "
+        f"bidding class shed {bid.get('shed')} request(s) while "
+        "best_effort shed none despite carrying traffic — class "
+        "shedding order is inverted (best_effort must absorb pressure "
+        "first, bidding last); check serve_qos_best_effort_frac < "
+        "serve_qos_normal_frac and client class labels "
+        "(docs/SERVING.md)",
+    )]
+
+
+# scache_thrash gates (serve/scache.py windows in serve_stats rows)
+SCACHE_THRASH_HIT_RATE = 0.1
+SCACHE_MIN_TRAFFIC = 100
+SCACHE_INVALIDATION_WINDOWS = 3
+
+
+def _check_scache(rows: list[dict]) -> list[Diagnosis]:
+    """Hot-key score-cache health from the cache_* fields the fleet
+    folds into ``serve_stats`` windows (serve/scache.py).  Each run's
+    FIRST cache window is exempt (a cold cache legitimately misses on
+    everything — same warmup discipline as ``_check_store``).
+
+    * **scache_thrash** — the hit rate stayed under
+      ``SCACHE_THRASH_HIT_RATE`` with non-trivial traffic after
+      warmup (the working set exceeds capacity, or traffic is not
+      zipf-shaped enough to cache), or invalidations landed in
+      ``SCACHE_INVALIDATION_WINDOWS``+ windows (rollouts churn the
+      cache faster than it can warm) — either way the cache is
+      costing memory without returning throughput."""
+    warm: list[dict] = []
+    invalidating = 0
+    for run in split_runs(rows):
+        crows = [
+            r for r in run.rows
+            if r.get("kind") == "serve_stats" and "cache_hits" in r
+        ]
+        warm.extend(crows[1:])
+        invalidating += sum(
+            1 for r in crows
+            if int(r.get("cache_invalidations", 0)) > 0
+        )
+    cold = [
+        r for r in warm
+        if float(r.get("cache_hit_rate", 1.0)) < SCACHE_THRASH_HIT_RATE
+        and (
+            int(r.get("cache_hits", 0)) + int(r.get("cache_misses", 0))
+        ) >= SCACHE_MIN_TRAFFIC
+    ]
+    out = []
+    if cold:
+        r = cold[-1]
+        out.append(Diagnosis(
+            "warn",
+            "scache_thrash",
+            f"score-cache thrash in {len(cold)} stats window(s): hit "
+            f"rate {float(r.get('cache_hit_rate', 0.0)):.2f} stayed "
+            f"below {SCACHE_THRASH_HIT_RATE} after warmup over "
+            f"{int(r.get('cache_hits', 0)) + int(r.get('cache_misses', 0))} "
+            f"lookups ({r.get('cache_entries')} entries, "
+            f"{r.get('cache_evictions')} evictions) — the hot set "
+            "exceeds serve_cache_capacity or the traffic is not "
+            "skewed enough to cache; raise capacity or disable the "
+            "cache (docs/SERVING.md)",
+        ))
+    elif invalidating >= SCACHE_INVALIDATION_WINDOWS:
+        out.append(Diagnosis(
+            "warn",
+            "scache_thrash",
+            f"score-cache churn: cache invalidations landed in "
+            f"{invalidating} stats window(s) — rollouts are evicting "
+            "the cache faster than it can warm, so it costs memory "
+            "without returning throughput; batch the rollouts or "
+            "disable the cache (docs/SERVING.md)",
+        ))
+    return out
+
+
 def _median(vals: list[float]) -> float:
     s = sorted(vals)
     return s[len(s) // 2] if s else 0.0
@@ -997,6 +1106,8 @@ def diagnose(
             d.code == "serve_queue_stall" for d in findings
         ),
     ))
+    findings.extend(_check_qos(rows))
+    findings.extend(_check_scache(rows))
     findings.extend(_check_reqtrace(
         rows,
         shed_storm=any(d.code == "shed_storm" for d in findings),

@@ -388,12 +388,27 @@ OPTIONAL: dict[str, dict[str, Any]] = {
     "serve_stats": {
         "per_bucket": dict,
         "shed_total": int,
+        # hot-key score cache window (serve/scache.py) — only fleets
+        # with a cache attached write these
+        "cache_hits": int,
+        "cache_misses": int,
+        "cache_hit_rate": (int, float),
+        "cache_entries": int,
+        "cache_bytes": int,
+        "cache_evictions": int,
+        "cache_invalidations": int,
+        "cache_inserts_dropped": int,
     },
     # scored-and-returned count alongside admitted (completions lag
     # admissions by the in-flight window; rows from before the counter
     # predate the field)
     "serve_shed": {
         "completed": int,
+        # per-QoS-class admitted/shed split (serve/fleet.py
+        # QOS_CLASSES) — additive like per_bucket: pre-QoS metrics
+        # streams without it still validate (pinned by
+        # tests/test_serve_binary.py back-compat test)
+        "by_class": dict,
     },
     # loadgen rows only (serve/loadgen.py open-loop SLO accounting;
     # the closed-loop `bench` CLI predates these fields)
@@ -415,6 +430,13 @@ OPTIONAL: dict[str, dict[str, Any]] = {
         # row NAMES its tail so `obs doctor`'s attribution and a
         # human reading the row point at the same span trees
         "slowest_exemplars": list,
+        # which wire carried the traffic: "fleet" (in-process),
+        # "http", or "binary" — the two-leg SLO gate
+        # (check_serve_slo.py --compare-transports) keys on it
+        "transport": str,
+        # mixed-QoS runs only: offered/shed counts per class
+        "qos_offered": dict,
+        "qos_shed": dict,
     },
     # per-variant fields (span "request" vs "batch" share only the
     # trunk — requiring the union would fail every row)

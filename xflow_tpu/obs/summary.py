@@ -164,6 +164,47 @@ def format_run(run: Run) -> str:
             "(cold occurrences per table touch; docs/PERF.md "
             "\"Wire format and compaction\")"
         )
+    sheds = run.kind("serve_shed")
+    if sheds:
+        total_shed = sum(int(r.get("shed_total", 0)) for r in sheds)
+        total_adm = sum(int(r.get("admitted", 0)) for r in sheds)
+        line = (
+            f"serve shed: {total_shed} shed vs {total_adm} admitted "
+            f"across {len(sheds)} window(s)"
+        )
+        agg: dict[str, dict[str, int]] = {}
+        for r in sheds:
+            for c, d in (r.get("by_class") or {}).items():
+                a = agg.setdefault(c, {"admitted": 0, "shed": 0})
+                a["admitted"] += int(d.get("admitted", 0))
+                a["shed"] += int(d.get("shed", 0))
+        if agg:
+            # protection order, best-protected first (fleet.QOS_CLASSES)
+            order = ["bidding", "normal", "best_effort"]
+            line += "; per class shed/offered: " + ", ".join(
+                f"{c} {agg[c]['shed']}/"
+                f"{agg[c]['admitted'] + agg[c]['shed']}"
+                for c in order + sorted(set(agg) - set(order))
+                if c in agg
+            )
+        out.append(line)
+    cstats = [r for r in run.kind("serve_stats") if "cache_hits" in r]
+    if cstats:
+        hits = sum(int(r.get("cache_hits", 0)) for r in cstats)
+        misses = sum(int(r.get("cache_misses", 0)) for r in cstats)
+        inval = sum(
+            int(r.get("cache_invalidations", 0)) for r in cstats
+        )
+        last = cstats[-1]
+        rate = hits / (hits + misses) if (hits + misses) else 0.0
+        out.append(
+            f"score cache: hit rate {rate:.2f} "
+            f"({hits} hit(s) / {misses} miss(es)), "
+            f"{last.get('cache_entries', 0)} entries "
+            f"({float(last.get('cache_bytes', 0)) / 2**20:.2f} MiB), "
+            f"{inval} invalidation(s) "
+            "(docs/SERVING.md \"Binary transport and QoS\")"
+        )
     fresh = run.kind("freshness")
     if fresh:
         commits = sorted(

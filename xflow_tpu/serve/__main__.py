@@ -251,6 +251,7 @@ def cmd_serve(args) -> int:
         deadline_budget_ms=args.deadline_budget_ms,
         depth_budget=args.depth_budget,
         flight=flight,
+        cache_capacity=args.cache_capacity,
     )
     logger = _serve_logger(
         args.metrics_out, fleet.digest, fleet.cfg.model, "serve"
@@ -292,6 +293,21 @@ def cmd_serve(args) -> int:
     )
     tier.watchdog = wd
     tier.alerts = alerts
+    # binary front end (serve/binary.py): the persistent XFB1
+    # transport, sharing the SAME fleet (and therefore the same
+    # admission control, cache, and stats windows) as the HTTP tier
+    btier = None
+    if args.binary_port >= 0:
+        from xflow_tpu.serve.binary import BinaryTier
+
+        btier = BinaryTier(
+            fleet,
+            host=args.host,
+            port=args.binary_port,
+            flight=flight,
+            score_timeout_s=fleet.cfg.serve_score_timeout_s,
+            socket_timeout_s=fleet.cfg.serve_socket_timeout_s,
+        ).start()
 
     stop = threading.Event()
 
@@ -304,11 +320,15 @@ def cmd_serve(args) -> int:
     wd.start()
     print(json.dumps({
         "serving": tier.address,
+        "binary": btier.address if btier is not None else None,
         "digest": fleet.digest,
         "model": fleet.cfg.model,
         "replicas": fleet.replicas,
         "buckets": list(fleet.engines[0].buckets),
         "admission": fleet.policy.describe(),
+        "cache_capacity": (
+            fleet.cache.capacity if fleet.cache is not None else 0
+        ),
     }, sort_keys=True), flush=True)
     # stats-window loop IS the main thread's job until a drain signal
     while not stop.wait(args.stats_every_s):
@@ -319,6 +339,10 @@ def cmd_serve(args) -> int:
             dict(out["shed"], kind="serve_shed"),
         ])
     wd.stop()
+    # binary front end first: it only submits into the fleet, so the
+    # tier/fleet close below still drains whatever it admitted
+    if btier is not None:
+        btier.close()
     final = tier.close()
     if logger is not None:
         logger.close()
@@ -461,11 +485,32 @@ def cmd_cascade(args) -> int:
     return 0
 
 
+def _parse_qos_mix(text: str) -> dict | None:
+    """"bidding=0.3,normal=0.5,best_effort=0.2" → class fractions."""
+    if not text:
+        return None
+    mix = {}
+    for part in text.split(","):
+        name, sep, frac = part.partition("=")
+        if not sep:
+            raise ValueError(
+                f"bad --qos-mix entry {part!r} (want class=frac)"
+            )
+        mix[name.strip()] = float(frac)
+    return mix
+
+
 def cmd_loadgen(args) -> int:
     from xflow_tpu.obs.schema import load_jsonl, validate_rows
-    from xflow_tpu.serve.loadgen import HttpTarget, run_loadgen
+    from xflow_tpu.serve.loadgen import (
+        BinaryTarget,
+        HttpTarget,
+        run_loadgen,
+    )
 
-    if args.url:
+    qos_mix = _parse_qos_mix(args.qos_mix)
+    remote_target = None
+    if args.url or args.binary_addr:
         # remote mode: the artifact supplies only the key space
         from xflow_tpu.config import Config
         from xflow_tpu.serve.artifact import load_manifest
@@ -475,9 +520,27 @@ def cmd_loadgen(args) -> int:
         model = manifest["model"]
         cfg = Config.from_json(manifest["config"])
         table_size = int(cfg.table_size)
-        target: object = HttpTarget(
-            args.url, timeout_s=cfg.serve_client_timeout_s
-        )
+        if args.binary_addr:
+            host, _, port = args.binary_addr.rpartition(":")
+            depth = (
+                args.pipeline_depth
+                if args.pipeline_depth is not None
+                else cfg.serve_pipeline_depth
+            )
+            remote_target = BinaryTarget(
+                host or "127.0.0.1",
+                int(port),
+                timeout_s=cfg.serve_client_timeout_s,
+                pipeline_depth=depth,
+                qos=args.qos or None,
+            )
+        else:
+            remote_target = HttpTarget(
+                args.url,
+                timeout_s=cfg.serve_client_timeout_s,
+                qos=args.qos or None,
+            )
+        target: object = remote_target
         fleet = None
     else:
         from xflow_tpu.serve.fleet import ReplicaFleet
@@ -490,6 +553,8 @@ def cmd_loadgen(args) -> int:
             max_wait_ms=args.max_wait_ms,
             deadline_budget_ms=args.deadline_budget_ms,
             depth_budget=args.depth_budget,
+            cache_capacity=args.cache_capacity,
+            **({"default_qos": args.qos} if args.qos else {}),
         )
         digest, model = fleet.digest, fleet.cfg.model
         table_size = None
@@ -499,6 +564,7 @@ def cmd_loadgen(args) -> int:
         fleet.metrics_logger = logger
         fleet.reqtrace = _reqtrace_sink(logger, args.reqtrace_sample)
         fleet.log_load(args.artifact)
+    remote = bool(args.url or args.binary_addr)
     try:
         summary = run_loadgen(
             target,
@@ -512,12 +578,15 @@ def cmd_loadgen(args) -> int:
             metrics_logger=logger,
             # remote tier: no local sink to auto-enable on, so the
             # flag itself arms client-side minting over the XFS2 wire
-            trace=(args.reqtrace_sample > 0) if args.url else None,
+            trace=(args.reqtrace_sample > 0) if remote else None,
             trace_sample=args.reqtrace_sample,
+            qos_mix=qos_mix,
         )
     finally:
         if fleet is not None:
             fleet.close()
+        if remote_target is not None and hasattr(remote_target, "close"):
+            remote_target.close()
         if logger is not None:
             logger.close()
     if args.metrics_out:
@@ -593,6 +662,17 @@ def main(argv: list[str] | None = None) -> int:
     pv.add_argument("--host", default="127.0.0.1")
     pv.add_argument("--port", type=int, default=8000)
     pv.add_argument(
+        "--binary-port", type=int, default=-1,
+        help="also serve the persistent XFB1 binary transport on this "
+        "port (0 = ephemeral, -1 = off; serve/binary.py) — same "
+        "fleet, admission control, and cache as the HTTP tier",
+    )
+    pv.add_argument(
+        "--cache-capacity", type=int, default=None,
+        help="hot-key score cache entries (serve/scache.py; 0 = off; "
+        "default = the artifact config's serve_cache_capacity)",
+    )
+    pv.add_argument(
         "--canary-frac", type=float, default=0.1,
         help="default canary traffic fraction for POST /v1/rollout",
     )
@@ -661,6 +741,35 @@ def main(argv: list[str] | None = None) -> int:
         "--url", default="",
         help="target a RUNNING tier instead of an in-process fleet "
         "(the artifact then only supplies the key space)",
+    )
+    pl.add_argument(
+        "--binary-addr", default="",
+        help="target a RUNNING binary tier at HOST:PORT over the "
+        "pipelined XFB1 transport (serve/loadgen.py::BinaryTarget) "
+        "instead of HTTP",
+    )
+    pl.add_argument(
+        "--pipeline-depth", type=int, default=None,
+        help="max in-flight XFB1 frames per connection (binary "
+        "transport; default = the artifact config's "
+        "serve_pipeline_depth)",
+    )
+    pl.add_argument(
+        "--qos", default="",
+        help="QoS admission class for ALL offered traffic "
+        "(bidding|normal|best_effort; default = the tier default)",
+    )
+    pl.add_argument(
+        "--qos-mix", default="",
+        help="mixed-class traffic, e.g. "
+        "'bidding=0.3,normal=0.5,best_effort=0.2' — classes "
+        "interleave at these fractions; the summary carries "
+        "qos_offered/qos_shed per class",
+    )
+    pl.add_argument(
+        "--cache-capacity", type=int, default=None,
+        help="hot-key score cache entries for the in-process fleet "
+        "(0 = off; default = the artifact config knob)",
     )
     pl.add_argument("--qps", type=float, default=500.0)
     pl.add_argument("--duration-s", type=float, default=10.0)

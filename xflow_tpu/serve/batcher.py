@@ -80,10 +80,20 @@ class MicroBatcher:
         flight=None,
         emit_on_close: bool = True,
         topk: bool = False,
+        cache=None,
     ):
         if max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
         self._engine = engine
+        # hot-key score cache (serve/scache.py): the worker INSERTS
+        # scored rows here, keyed by the scoring engine's OWN servable
+        # digest — bitwise-correct by construction (the cached value IS
+        # what that engine returned).  Lookups happen upstream
+        # (serve/fleet.py submit); the cache itself digest-guards
+        # inserts, so a batch scored on a pre-rollout engine that
+        # resolves after the commit is dropped, not cached stale.
+        # topk batchers never cache (tuple results, not scalar pctrs).
+        self._cache = None if topk else cache
         # top-k mode (retrieval fleets, docs/SERVING.md cascade): the
         # worker coalesces exactly like score mode but runs the
         # engine's topk leg; each Future resolves to (item_ids [k],
@@ -449,13 +459,23 @@ class MicroBatcher:
                 bucket,
                 phases,
             )
-        for i, (_, fut, t_enq, span) in enumerate(reqs):
+        cache = self._cache
+        cache_digest = (
+            getattr(engine, "servable_digest", None)
+            if cache is not None and not self._topk
+            else None
+        )
+        for i, (row, fut, t_enq, span) in enumerate(reqs):
             reg.observe("serve.featurize_seconds", feat)
             reg.observe("serve.device_seconds", dev)
             reg.observe(f"serve.e2e.b{bucket}", t2 - t_enq)
             if span is not None:
                 span.bucket = bucket
                 span.sink.complete(span)
+            if cache_digest is not None:
+                # insert BEFORE resolving the Future: a caller that
+                # saw its score can already hit the cache with it
+                cache.insert(cache_digest, *row, float(pctr[i]))
             if self._topk:
                 # the scoring engine's index rides along: candidate
                 # ids are only meaningful against the index that

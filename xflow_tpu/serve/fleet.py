@@ -72,6 +72,15 @@ from xflow_tpu.obs.registry import Histogram, MetricsRegistry
 from xflow_tpu.obs.schema import health_row
 from xflow_tpu.serve.batcher import MicroBatcher, stats_row_from_snapshot
 
+# QoS admission classes, best-protected first.  All classes share one
+# queue; lower classes see SCALED admission budgets (ReplicaFleet
+# qos_normal_frac / qos_best_effort_frac), so as pressure mounts
+# best_effort crosses its (smallest) budget and sheds first, normal
+# next, and bidding — the auction-critical path — last, at the full
+# budget.  The wire carries the class as the XFB1 frame's QoS byte
+# (serve/binary.py) or the X-XFlow-QoS header (serve/server.py).
+QOS_CLASSES = ("bidding", "normal", "best_effort")
+
 
 class ShedError(RuntimeError):
     """Typed backpressure: the request was REJECTED by admission
@@ -79,14 +88,17 @@ class ShedError(RuntimeError):
     HTTP front end maps this to 429 with the cause in the body)."""
 
     def __init__(self, cause: str, depth: int, queue_age_s: float,
-                 budget: str):
+                 budget: str, qos: str | None = None):
         super().__init__(
             f"request shed: {cause} (depth {depth}, oldest queued "
-            f"{queue_age_s * 1e3:.1f}ms, budget {budget})"
+            f"{queue_age_s * 1e3:.1f}ms, budget {budget}"
+            + (f", class {qos}" if qos else "")
+            + ")"
         )
         self.cause = cause
         self.depth = depth
         self.queue_age_s = queue_age_s
+        self.qos = qos
 
 
 class RolloutError(RuntimeError):
@@ -124,6 +136,17 @@ class AdmissionPolicy:
             f"depth<{self.depth_budget}"
         )
 
+    def scaled(self, frac: float) -> "AdmissionPolicy":
+        """A strictly-tighter copy for a lower QoS class: both budgets
+        scaled by ``frac`` (depth floored at 1 so the class can still
+        admit on an idle fleet)."""
+        if not 0.0 < frac <= 1.0:
+            raise ValueError("QoS budget fraction must be in (0, 1]")
+        return AdmissionPolicy(
+            deadline_budget_ms=self.deadline_budget_s * 1000.0 * frac,
+            depth_budget=max(1, int(self.depth_budget * frac)),
+        )
+
 
 class ReplicaFleet:
     def __init__(
@@ -142,12 +165,38 @@ class ReplicaFleet:
         revive: bool = True,
         topk: bool = False,
         reqtrace=None,
+        qos_normal_frac: float = 0.75,
+        qos_best_effort_frac: float = 0.45,
+        default_qos: str = "normal",
+        cache=None,
     ):
         if replicas < 1:
             raise ValueError("a fleet needs at least 1 replica")
         if evict_after_errors < 1:
             raise ValueError("evict_after_errors must be >= 1")
+        if default_qos not in QOS_CLASSES:
+            raise ValueError(
+                f"default_qos {default_qos!r} not in {QOS_CLASSES}"
+            )
+        if not (0.0 < qos_best_effort_frac <= qos_normal_frac <= 1.0):
+            raise ValueError(
+                "need 0 < qos_best_effort_frac <= qos_normal_frac <= 1 "
+                "(best_effort sheds first, bidding last)"
+            )
         self.policy = AdmissionPolicy(deadline_budget_ms, depth_budget)
+        # per-class admission: bidding at the FULL budget, lower
+        # classes strictly tighter — the ordering invariant `obs
+        # doctor` checks as qos_inversion
+        self.policies = {
+            "bidding": self.policy,
+            "normal": self.policy.scaled(qos_normal_frac),
+            "best_effort": self.policy.scaled(qos_best_effort_frac),
+        }
+        self.default_qos = default_qos
+        # topk fleets never cache: entries are scalar pctrs, not
+        # (ids, scores) pairs
+        if topk:
+            cache = None
         self.registry = registry if registry is not None else MetricsRegistry()
         self.metrics_logger = metrics_logger
         self.flight = flight
@@ -178,6 +227,7 @@ class ReplicaFleet:
                 flight=flight,
                 emit_on_close=False,
                 topk=topk,
+                cache=cache,
             )
             for e in self.engines
         ]
@@ -192,6 +242,9 @@ class ReplicaFleet:
         self._completed = 0
         self._errors = 0
         self._shed: dict[str, int] = {}
+        # per-QoS-class window counters (serve_shed by_class)
+        self._class_admitted = {c: 0 for c in QOS_CLASSES}
+        self._class_shed = {c: 0 for c in QOS_CLASSES}
         # replica health (docs/ROBUSTNESS.md): a replica whose scoring
         # keeps raising is EVICTED from routing (capacity shrinks, so
         # AdmissionPolicy sheds the overflow at the door) and a
@@ -221,6 +274,16 @@ class ReplicaFleet:
         # change; the continuous driver and /v1/stats read it to tell
         # which model VERSION traffic converged on
         self.servable = getattr(engine, "servable_digest", "?")
+        # hot-key score cache (serve/scache.py) in front of the
+        # batchers (they insert; submit() looks up).  The cache pins
+        # THIS fleet's servable digest; commit_rollout re-pins it
+        # inside the same critical section that swaps `servable`, so
+        # lookups and inserts can never disagree about the current
+        # version.
+        self.cache = cache
+        if self.cache is not None:
+            self.cache.registry = self.registry
+            self.cache.set_current(self.servable)
 
     # -- construction -------------------------------------------------------
 
@@ -235,13 +298,18 @@ class ReplicaFleet:
         obs=None,
         warm: bool = True,
         topk_k: int | None = None,
+        cache_capacity: int | None = None,
         **kw,
     ) -> "ReplicaFleet":
         """Load one artifact from the shared store and fan it out to
         ``replicas`` clones (one compile set, shared weights).
         ``topk_k`` sizes the compiled top-k width for retrieval
         artifacts (engine.load attaches their item index either
-        way)."""
+        way).  ``cache_capacity`` sizes the hot-key score cache
+        (serve/scache.py; 0 = off, None = the artifact config's
+        ``serve_cache_capacity`` knob); the artifact's QoS budget
+        fractions seed the per-class admission policies unless
+        overridden in ``kw``."""
         from xflow_tpu.serve.engine import PredictEngine
 
         engine = PredictEngine.load(
@@ -252,6 +320,18 @@ class ReplicaFleet:
             warm=warm,
             topk_k=topk_k,
         )
+        cfg = engine.cfg
+        kw.setdefault("qos_normal_frac", cfg.serve_qos_normal_frac)
+        kw.setdefault(
+            "qos_best_effort_frac", cfg.serve_qos_best_effort_frac
+        )
+        if "cache" not in kw:
+            if cache_capacity is None:
+                cache_capacity = cfg.serve_cache_capacity
+            if cache_capacity > 0:
+                from xflow_tpu.serve.scache import ScoreCache
+
+                kw["cache"] = ScoreCache(cache_capacity)
         fleet = cls(engine, replicas, **kw)
         # rollouts load candidates the same way this fleet was loaded
         fleet._load_kw = {
@@ -341,7 +421,8 @@ class ReplicaFleet:
                 return others[self._rr % len(others)], None
             return healthy[self._seq % len(healthy)], None
 
-    def submit(self, keys, slots=None, vals=None, trace=None) -> Future:
+    def submit(self, keys, slots=None, vals=None, trace=None,
+               qos: str | None = None) -> Future:
         """Admission-checked enqueue onto one replica; returns the
         pctr Future.  Raises :class:`ShedError` when the replica's
         backlog breaches the deadline budget — the typed backpressure
@@ -350,39 +431,77 @@ class ReplicaFleet:
         wire; with a sink attached, the span opens HERE (t_arrival)
         so admission wait + routing are inside the tree — sheds
         complete immediately with status "shed" (always kept by the
-        sampler)."""
+        sampler).
+
+        ``qos`` picks the admission class (QOS_CLASSES; None = the
+        fleet's ``default_qos``) — each class checks ITS policy, so
+        under pressure best_effort sheds first and bidding last.
+
+        With a score cache attached, a row already scored by the
+        CURRENT servable resolves right here — no routing, no queue,
+        no device.  Cache lookups are suspended while a rollout is
+        open so the canary stripe sees full traffic (a cache-starved
+        health gate would never accumulate its min_requests)."""
+        if qos is None:
+            qos = self.default_qos
+        elif qos not in QOS_CLASSES:
+            raise ValueError(
+                f"unknown QoS class {qos!r} (want one of {QOS_CLASSES})"
+            )
         sink = self.reqtrace
         span = (
             sink.start(trace, self.reqtrace_stage)
             if sink is not None
             else None
         )
+        if self.cache is not None:
+            with self._lock:
+                servable = self.servable
+                cacheable = self._rollout is None and not self._closed
+            if cacheable:
+                score = self.cache.lookup(servable, keys, slots, vals)
+                if score is not None:
+                    with self._lock:
+                        self._admitted += 1
+                        self._completed += 1
+                        self._class_admitted[qos] += 1
+                    if span is not None:
+                        sink.complete(span, "ok", detail="cache_hit")
+                    fut: Future = Future()
+                    fut.set_result(score)
+                    return fut
         try:
             idx, ro_token = self._route()
         except ShedError as e:
+            with self._lock:
+                self._class_shed[qos] += 1
             if span is not None:
                 sink.complete(span, "shed", detail=e.cause)
+            e.qos = qos
             raise
         if span is not None:
             span.replica = idx
         batcher = self.batchers[idx]
-        cause = self.policy.check(batcher)
+        cause = self.policies[qos].check(batcher)
         if cause is not None:
             batcher.note_shed(cause)
             with self._lock:
                 self._shed[cause] = self._shed.get(cause, 0) + 1
+                self._class_shed[qos] += 1
             if span is not None:
                 sink.complete(span, "shed", detail=cause)
             raise ShedError(
                 cause,
                 batcher.depth(),
                 batcher.queue_age_s(),
-                self.policy.describe(),
+                self.policies[qos].describe(),
+                qos=qos,
             )
         t0 = time.perf_counter()
         fut = batcher.submit(keys, slots, vals, trace=span)
         with self._lock:
             self._admitted += 1
+            self._class_admitted[qos] += 1
         fut.add_done_callback(
             lambda f, t0=t0, ro=ro_token, i=idx: self._done(f, t0, ro, i)
         )
@@ -777,6 +896,16 @@ class ReplicaFleet:
                 self.engines[i] = b.engine
             self.digest = candidate.digest
             self.servable = getattr(candidate, "servable_digest", "?")
+            if self.cache is not None:
+                # re-pin + evict the old generation ATOMICALLY with
+                # the servable swap (scache.py's whole contract): no
+                # window where a lookup under the new digest could see
+                # a pre-swap score, and old-engine stragglers that
+                # resolve after this point insert under a digest the
+                # cache no longer accepts.  Lock order fleet._lock →
+                # ScoreCache._lock, acyclic (cache code never takes
+                # the fleet lock — XF007).
+                self.cache.set_current(self.servable)
             self._rollout = None
         with self._ro_log_lock:
             self._log_rollout("commit", ro, f"health {health}")
@@ -792,6 +921,10 @@ class ReplicaFleet:
             health = self._health_locked(ro)
             self.batchers[ro["canary"]].swap(ro["old"], force=True)
             self.engines[ro["canary"]] = ro["old"]
+            if self.cache is not None:
+                # servable unchanged on abort — same-digest re-pin is
+                # a no-op, but it defends the invariant explicitly
+                self.cache.set_current(self.servable)
             self._rollout = None
         with self._ro_log_lock:
             self._log_rollout("abort", ro, detail or f"health {health}")
@@ -857,6 +990,17 @@ class ReplicaFleet:
             "shed_total": total,
             "shed_frac": round(total / denom, 6) if denom else 0.0,
             "by_cause": dict(self._shed),
+            # per-QoS-class split (additive-OPTIONAL in obs/schema.py:
+            # pre-QoS streams without it still validate).  The
+            # ordering invariant — bidding sheds only after best_effort
+            # does — is what `obs doctor` checks as qos_inversion.
+            "by_class": {
+                c: {
+                    "admitted": self._class_admitted[c],
+                    "shed": self._class_shed[c],
+                }
+                for c in QOS_CLASSES
+            },
             "errors": self._errors,
         }
 
@@ -878,12 +1022,18 @@ class ReplicaFleet:
                     "p99": round(h["p99"], 6),
                 }
         row["per_bucket"] = per_bucket
+        if self.cache is not None:
+            # windowed cache counters ride the serve_stats row
+            # (additive-OPTIONAL fields in obs/schema.py)
+            row.update(self.cache.stats_row(reset=True))
         with self._lock:
             shed = self._shed_row_locked()
             self._admitted = 0
             self._completed = 0
             self._errors = 0
             self._shed = {}
+            self._class_admitted = {c: 0 for c in QOS_CLASSES}
+            self._class_shed = {c: 0 for c in QOS_CLASSES}
             ro = self._rollout
         shed["depth"] = self.depth()
         shed["queue_age_s"] = round(self.queue_age_s(), 6)
@@ -932,6 +1082,14 @@ class ReplicaFleet:
             "rollout": self.rollout_state(),
             "health": self.health(),
             "compiles": engine0.compile_count,
+            "qos": {
+                c: self.policies[c].describe() for c in QOS_CLASSES
+            },
+            "cache": (
+                self.cache.stats_row(reset=False)
+                if self.cache is not None
+                else None
+            ),
         }
 
     def close(self) -> dict:

@@ -88,15 +88,22 @@ class HttpTarget:
     rejection.  Retries are counted in ``self.retried`` and land in
     the ``serve_bench`` row."""
 
+    transport = "http"
+
     def __init__(
         self,
         url: str,
         timeout_s: float = 30.0,
         max_retries: int = 2,
         backoff_cap_s: float = 1.0,
+        qos: str | None = None,
     ):
         from urllib.parse import urlsplit
 
+        # default QoS admission class for every request this target
+        # offers (rides the X-XFlow-QoS header); per-submit qos=
+        # overrides.  None = let the tier apply its fleet default.
+        self.qos = qos
         self.url = url.rstrip("/")
         parts = urlsplit(self.url)
         if parts.scheme not in ("http", ""):
@@ -113,10 +120,14 @@ class HttpTarget:
         self._retry_lock = threading.Lock()
         self.retried = 0
 
-    def _post(self, path: str, body: bytes) -> tuple[int, bytes, str]:
+    def _post(self, path: str, body: bytes,
+              headers: dict | None = None) -> tuple[int, bytes, str]:
         """(status, payload, Retry-After header or "")."""
         import http.client
 
+        hdrs = {"Content-Type": "application/octet-stream"}
+        if headers:
+            hdrs.update(headers)
         conn = getattr(self._local, "conn", None)
         reused = conn is not None
         for attempt in (0, 1):
@@ -127,8 +138,7 @@ class HttpTarget:
                 self._local.conn = conn
             try:
                 conn.request(
-                    "POST", self._path + path, body=body,
-                    headers={"Content-Type": "application/octet-stream"},
+                    "POST", self._path + path, body=body, headers=hdrs,
                 )
                 r = conn.getresponse()
                 return (
@@ -172,10 +182,12 @@ class HttpTarget:
                 pass  # HTTP-date form / garbage: keep the fallback
         return min(base * 2.0**attempt, self.backoff_cap_s)
 
-    def submit(self, keys, slots=None, vals=None, trace=None) -> Future:
+    def submit(self, keys, slots=None, vals=None, trace=None,
+               qos: str | None = None) -> Future:
         """``trace`` (a ``TraceContext``) rides the packed wire's XFS2
         traced variant so the tier's reqtrace spans correlate with
-        this client's trace ids (obs/reqtrace.py)."""
+        this client's trace ids (obs/reqtrace.py).  ``qos`` overrides
+        the target's default admission class for this request."""
         import json
 
         from xflow_tpu.serve.server import (
@@ -183,12 +195,14 @@ class HttpTarget:
             encode_packed_request,
         )
 
+        qos = qos if qos is not None else self.qos
+        headers = {"X-XFlow-QoS": qos} if qos is not None else None
         fut: Future = Future()
         body = encode_packed_request([(keys, slots, vals)], trace=trace)
         for attempt in range(self.max_retries + 1):
             try:
                 status, payload, retry_after = self._post(
-                    "/v1/score_packed", body
+                    "/v1/score_packed", body, headers=headers
                 )
             except Exception as e:  # connection errors → failed request
                 fut.set_exception(e)
@@ -206,6 +220,7 @@ class HttpTarget:
                     int(doc.get("depth", 0)),
                     float(doc.get("queue_age_ms", 0.0)) / 1000.0,
                     "remote",
+                    qos=doc.get("qos", qos),
                 )
             with self._retry_lock:
                 self.retried += 1
@@ -222,6 +237,235 @@ class HttpTarget:
         return fut
 
 
+class _BinConn:
+    """One worker stripe's persistent XFB1 connection: a send side
+    (the stripe's own thread), a reader thread resolving responses by
+    request id, and a pipelining semaphore bounding frames in
+    flight."""
+
+    def __init__(self, sock, depth: int):
+        self.sock = sock
+        self.lock = threading.Lock()
+        self.pending: dict[int, tuple[Future, str]] = {}
+        # plain Semaphore, not Bounded: connection teardown releases
+        # one permit per failed pending frame, racing normal releases
+        self.sem = threading.Semaphore(depth)
+        self.rid = 0
+        self.buf = bytearray()
+        self.off = 0
+        self.reader: threading.Thread | None = None
+        self.dead = False
+
+
+class BinaryTarget:
+    """The fleet ``submit`` protocol over the persistent XFB1 binary
+    transport (serve/binary.py).  Unlike :class:`HttpTarget` — one
+    synchronous request per worker connection — this target PIPELINES:
+    each worker stripe keeps one persistent connection with up to
+    ``pipeline_depth`` frames in flight, and ``submit`` returns its
+    Future as soon as the frame is written (a per-connection reader
+    thread matches responses by request id).  That makes binary runs
+    truly open-loop like in-process fleet runs, at any latency.
+
+    A shed response (status 1 — the wire's typed 429) resolves the
+    Future with a :class:`ShedError`; the loadgen's recorder books it
+    as a shed, not an error, so both transports produce comparable
+    ``serve_bench`` rows.  No transparent retry on this path: a
+    pipelined stream re-offering frames would reorder the open-loop
+    timeline (``retried`` stays 0; the HTTP leg's backoff is its own
+    transport's discipline)."""
+
+    transport = "binary"
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        timeout_s: float = 30.0,
+        pipeline_depth: int = 32,
+        qos: str | None = None,
+    ):
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self.pipeline_depth = pipeline_depth
+        self.qos = qos
+        self._local = threading.local()
+        self._conns_lock = threading.Lock()
+        self._conns: list[_BinConn] = []
+        self._closed = False
+
+    def _conn(self) -> _BinConn:
+        import socket as _socket
+
+        conn = getattr(self._local, "conn", None)
+        if conn is not None and not conn.dead:
+            return conn
+        if self._closed:
+            raise RuntimeError("BinaryTarget is closed")
+        sock = _socket.create_connection(
+            (self.host, self.port), timeout=self.timeout_s
+        )
+        sock.setsockopt(
+            _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+        )
+        conn = _BinConn(sock, self.pipeline_depth)
+        # not fire-and-forget: tracked in self._conns and joined
+        # (bounded) by close() (xf: ignore[XF006])
+        conn.reader = threading.Thread(
+            target=self._read_loop, args=(conn,),
+            name="xflow-binary-reader", daemon=True,
+        )
+        conn.reader.start()
+        with self._conns_lock:
+            self._conns.append(conn)
+        self._local.conn = conn
+        return conn
+
+    def _read_loop(self, conn: _BinConn) -> None:
+        from xflow_tpu.serve.binary import _frame_at
+
+        try:
+            # client-side reader, not a serving worker: bounded by the
+            # socket timeout (recv raises) and exits on EOF/close —
+            # the flight recorder lives server-side
+            # (xf: ignore[XF009])
+            while True:
+                data = conn.sock.recv(1 << 16)
+                if not data:
+                    break
+                conn.buf += data
+                # bounded by the bytes just buffered (_frame_at breaks
+                # on an incomplete frame) (xf: ignore[XF009])
+                while True:
+                    got = _frame_at(conn.buf, conn.off)
+                    if got is None:
+                        break
+                    rid, status, body, conn.off = got
+                    self._resolve(conn, rid, status, body)
+                if conn.off:
+                    del conn.buf[:conn.off]
+                    conn.off = 0
+        except (OSError, ValueError):
+            pass  # teardown below fails whatever is still pending
+        finally:
+            self._teardown(
+                conn, ConnectionError("binary connection closed")
+            )
+
+    def _resolve(self, conn: _BinConn, rid: int, status: int,
+                 body: bytes) -> None:
+        import json
+
+        from xflow_tpu.serve import binary
+        from xflow_tpu.serve.server import decode_packed_response
+
+        with conn.lock:
+            entry = conn.pending.pop(rid, None)
+        if entry is None:
+            return  # duplicate/unknown id: nothing is waiting
+        conn.sem.release()
+        fut, qos = entry
+        try:
+            if status == binary.STATUS_OK:
+                fut.set_result(float(decode_packed_response(body)[0]))
+                return
+            doc = json.loads(body.decode() or "{}")
+            if status == binary.STATUS_SHED:
+                fut.set_exception(ShedError(
+                    doc.get("cause", "unknown"),
+                    int(doc.get("depth", 0)),
+                    float(doc.get("queue_age_ms", 0.0)) / 1000.0,
+                    "remote",
+                    qos=doc.get("qos", qos),
+                ))
+            elif status == binary.STATUS_TIMEOUT:
+                fut.set_exception(TimeoutError(
+                    doc.get("error", "scoring timed out")
+                ))
+            else:
+                fut.set_exception(RuntimeError(
+                    doc.get("error", f"binary status {status}")
+                ))
+        except Exception as e:  # malformed body: still resolve
+            if not fut.done():
+                fut.set_exception(e)
+
+    def _teardown(self, conn: _BinConn, err: Exception) -> None:
+        with conn.lock:
+            conn.dead = True
+            pending = list(conn.pending.values())
+            conn.pending.clear()
+        for fut, _ in pending:
+            conn.sem.release()
+            if not fut.done():
+                fut.set_exception(err)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def submit(self, keys, slots=None, vals=None, trace=None,
+               qos: str | None = None) -> Future:
+        from xflow_tpu.serve.binary import encode_frame
+        from xflow_tpu.serve.server import encode_packed_request
+
+        qos = qos if qos is not None else (self.qos or "normal")
+        body = encode_packed_request([(keys, slots, vals)], trace=trace)
+        conn = self._conn()
+        # pipelining bound (XF017-bounded: the server's deadline sweep
+        # answers every frame within its score timeout, so permits
+        # always come back)
+        if not conn.sem.acquire(timeout=self.timeout_s):
+            raise TimeoutError(
+                f"pipeline full for {self.timeout_s}s "
+                f"(depth {self.pipeline_depth})"
+            )
+        fut: Future = Future()
+        with conn.lock:
+            if conn.dead:
+                conn.sem.release()
+                raise ConnectionError("binary connection closed")
+            conn.rid += 1
+            rid = conn.rid
+            conn.pending[rid] = (fut, qos)
+        try:
+            conn.sock.sendall(encode_frame(rid, qos, body))
+        except OSError:
+            self._teardown(
+                conn, ConnectionError("binary connection closed")
+            )
+            raise
+        return fut
+
+    def close(self) -> None:
+        self._closed = True
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.sock.shutdown(2)  # SHUT_RDWR: wake the reader
+            except OSError:
+                pass
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        for conn in conns:
+            if conn.reader is not None:
+                conn.reader.join(timeout=5.0)
+
+    def __enter__(self) -> "BinaryTarget":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
 class _Recorder:
     """Thread-safe completion sink (callbacks run on replica worker
     threads; workers read nothing until the drain barrier)."""
@@ -234,20 +478,27 @@ class _Recorder:
         self.errors = 0
         self.shed: dict[str, int] = {}
         self._shed_total = 0
+        # per-QoS-class offered/shed counts (mixed-class runs)
+        self.qos_offered: dict[str, int] = {}
+        self.qos_shed: dict[str, int] = {}
         # client-observed slowest-k (e2e seconds, trace id hex) — the
         # serve_bench row names its slowest exemplars by trace id so a
         # p99 outlier maps straight onto its reqtrace span tree
         self._slow_k = slow_k
         self._slow: list[tuple[float, str]] = []
 
-    def note_submit(self) -> None:
+    def note_submit(self, qos: str | None = None) -> None:
         with self._lock:
             self.submitted += 1
+            if qos is not None:
+                self.qos_offered[qos] = self.qos_offered.get(qos, 0) + 1
 
-    def note_shed(self, cause: str) -> None:
+    def note_shed(self, cause: str, qos: str | None = None) -> None:
         with self._lock:
             self.shed[cause] = self.shed.get(cause, 0) + 1
             self._shed_total += 1
+            if qos is not None:
+                self.qos_shed[qos] = self.qos_shed.get(qos, 0) + 1
 
     def note_error(self) -> None:
         """A request that failed AT submit (no Future ever existed) —
@@ -260,9 +511,18 @@ class _Recorder:
         self, fut: Future, t0: float, trace_id: str | None = None
     ) -> None:
         dt = time.perf_counter() - t0
+        err = fut.exception()
+        if isinstance(err, ShedError):
+            # a shed delivered THROUGH the Future (the pipelined
+            # binary transport's status-1 frame) is still a shed, not
+            # an error — booked like a door-shed so both transports'
+            # serve_bench rows compare like for like.  Not counted as
+            # completed: `outstanding` subtracts sheds separately.
+            self.note_shed(err.cause, qos=err.qos)
+            return
         with self._lock:
             self.completed += 1
-            if fut.exception() is not None:
+            if err is not None:
                 self.errors += 1
             else:
                 self._lat.observe(dt)
@@ -290,6 +550,8 @@ class _Recorder:
                 "completed": self.completed,
                 "errors": self.errors,
                 "shed": dict(self.shed),
+                "qos_offered": dict(self.qos_offered),
+                "qos_shed": dict(self.qos_shed),
                 "e2e_p50": round(self._lat.percentile(50), 6),
                 "e2e_p99": round(self._lat.percentile(99), 6),
             }
@@ -309,6 +571,7 @@ def run_loadgen(
     metrics_logger=None,
     trace: bool | None = None,
     trace_sample: float = 0.01,
+    qos_mix: dict[str, float] | None = None,
 ) -> dict:
     """Drive ``target`` (a ReplicaFleet or HttpTarget) with open-loop
     zipf traffic; returns (and optionally logs as ``serve_bench``) the
@@ -327,6 +590,36 @@ def run_loadgen(
         raise ValueError("offered_qps/duration_s/concurrency must be > 0")
     if zipf_a <= 1.0:
         raise ValueError("zipf_a must be > 1 (numpy zipf domain)")
+    # mixed-class traffic: arrival i's class comes from a 100-slot
+    # proportional pattern (deterministic — the same seed offers the
+    # same class sequence over both transports of a two-leg run)
+    qos_pattern: list[str] | None = None
+    if qos_mix:
+        from xflow_tpu.serve.fleet import QOS_CLASSES
+
+        bad = set(qos_mix) - set(QOS_CLASSES)
+        if bad:
+            raise ValueError(
+                f"unknown QoS class(es) {sorted(bad)} in qos_mix "
+                f"(want {QOS_CLASSES})"
+            )
+        total = sum(qos_mix.values())
+        if total <= 0:
+            raise ValueError("qos_mix fractions must sum > 0")
+        # error-accumulator (Bresenham) spread: classes INTERLEAVE at
+        # their fractions instead of arriving in per-class bursts —
+        # the same striping discipline the fleet's canary router uses
+        mix = {
+            c: qos_mix[c] / total for c in QOS_CLASSES if c in qos_mix
+        }
+        acc = dict.fromkeys(mix, 0.0)
+        qos_pattern = []
+        for _ in range(100):
+            for c in mix:
+                acc[c] += mix[c]
+            top = max(acc, key=lambda c: acc[c])
+            acc[top] -= 1.0
+            qos_pattern.append(top)
     sink = getattr(target, "reqtrace", None)
     if trace is None:
         trace = sink is not None
@@ -400,17 +693,24 @@ def run_loadgen(
             delay = (start + i / offered_qps) - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
-            rec.note_submit()
+            q = (
+                qos_pattern[i % len(qos_pattern)]
+                if qos_pattern is not None
+                else None
+            )
+            rec.note_submit(qos=q)
             ctx = mint() if mint is not None else None
             tid = f"{ctx.trace_id:016x}" if ctx is not None else None
+            kw: dict[str, Any] = {}
+            if ctx is not None:
+                kw["trace"] = ctx
+            if q is not None:
+                kw["qos"] = q
             t0 = time.perf_counter()
             try:
-                if ctx is not None:
-                    fut = target.submit(*rows[j], trace=ctx)
-                else:
-                    fut = target.submit(*rows[j])
+                fut = target.submit(*rows[j], **kw)
             except ShedError as e:
-                rec.note_shed(e.cause)
+                rec.note_shed(e.cause, qos=getattr(e, "qos", None) or q)
                 continue
             except Exception:
                 # a submit-side failure is ONE failed request, not a
@@ -481,7 +781,14 @@ def run_loadgen(
         # 429s the target transparently retried (HttpTarget honoring
         # Retry-After; in-process fleets never retry — 0)
         "retried": int(getattr(target, "retried", 0)),
+        # which wire carried the traffic ("fleet" = in-process): the
+        # two-leg SLO gate (check_serve_slo.py --compare-transports)
+        # picks its legs by this field
+        "transport": getattr(target, "transport", "fleet"),
     }
+    if qos_pattern is not None:
+        summary["qos_offered"] = snap["qos_offered"]
+        summary["qos_shed"] = snap["qos_shed"]
     if hasattr(target, "emit_stats"):
         rows = target.emit_stats()  # serve_stats + serve_shed flushed
         stats = rows["stats"]

@@ -64,7 +64,12 @@ from concurrent.futures import TimeoutError as FutureTimeout
 
 from xflow_tpu.chaos import ChaosError, failpoint
 from xflow_tpu.obs.reqtrace import TraceContext, format_header, parse_header
-from xflow_tpu.serve.fleet import ReplicaFleet, RolloutError, ShedError
+from xflow_tpu.serve.fleet import (
+    QOS_CLASSES,
+    ReplicaFleet,
+    RolloutError,
+    ShedError,
+)
 
 PACKED_MAGIC = b"XFS1"
 # traced packed request (ISSUE 16): magic + u64 trace_id + u64
@@ -292,13 +297,19 @@ class _Handler(BaseHTTPRequestHandler):
         retry_ms = max(
             1, int(self.tier.fleet.policy.deadline_budget_s * 1000)
         )
-        self._json(429, {
+        doc = {
             "error": "backpressure",
             "cause": e.cause,
             "depth": e.depth,
             "queue_age_ms": round(e.queue_age_s * 1000.0, 3),
             "retry_after_ms": retry_ms,
-        }, headers={"Retry-After": str(max(1, retry_ms // 1000))})
+        }
+        if e.qos is not None:
+            doc["qos"] = e.qos
+        self._json(
+            429, doc,
+            headers={"Retry-After": str(max(1, retry_ms // 1000))},
+        )
 
     # -- scoring ------------------------------------------------------------
 
@@ -322,14 +333,32 @@ class _Handler(BaseHTTPRequestHandler):
             "X-XFlow-Trace": format_header(ctx)
         }
 
-    def _score_rows(self, rows: list[tuple], trace=None) -> np.ndarray:
+    def _qos(self) -> str | None:
+        """The request's QoS admission class from the ``X-XFlow-QoS``
+        header (the HTTP twin of the XFB1 frame's QoS byte); None =
+        the fleet default.  Unlike a malformed trace header, an
+        UNKNOWN class is a 400: the client asked for an admission
+        contract the fleet does not have, and silently downgrading it
+        would defeat the whole point of classed shedding."""
+        raw = self.headers.get("X-XFlow-QoS")
+        if raw is None:
+            return None
+        qos = raw.strip().lower()
+        if qos not in QOS_CLASSES:
+            raise ValueError(
+                f"unknown QoS class {qos!r} (want one of {QOS_CLASSES})"
+            )
+        return qos
+
+    def _score_rows(self, rows: list[tuple], trace=None,
+                    qos: str | None = None) -> np.ndarray:
         """All-or-nothing admission: the first shed fails the whole
         request (already-admitted rows still score and resolve — the
         batcher drains them — but the client is told to back off).
         Every row of one HTTP request rides ONE trace id (each gets
         its own span)."""
         fleet = self.tier.fleet
-        futs = [fleet.submit(*row, trace=trace) for row in rows]
+        futs = [fleet.submit(*row, trace=trace, qos=qos) for row in rows]
         deadline = time.perf_counter() + self.tier.score_timeout_s
         return np.asarray([
             f.result(timeout=max(0.001, deadline - time.perf_counter()))
@@ -366,7 +395,7 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError(f"bad row field: {e}") from None
             rows.append((keys, slots, vals))
         ctx = self._trace_ctx(self.tier.fleet)
-        pctr = self._score_rows(rows, trace=ctx)
+        pctr = self._score_rows(rows, trace=ctx, qos=self._qos())
         self._json(200, {
             "pctr": [round(float(p), 6) for p in pctr],
             "digest": self.tier.fleet.digest,
@@ -375,7 +404,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _handle_score_packed(self, body: bytes) -> None:
         rows, wire_ctx = decode_packed_request_traced(body)
         ctx = self._trace_ctx(self.tier.fleet, wire=wire_ctx)
-        pctr = self._score_rows(rows, trace=ctx)
+        pctr = self._score_rows(rows, trace=ctx, qos=self._qos())
         self._respond(
             200, encode_packed_response(pctr), "application/octet-stream",
             headers=self._trace_headers(ctx),
